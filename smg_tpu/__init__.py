@@ -1,0 +1,20 @@
+"""smg_tpu — a TPU-native LLM serving framework.
+
+Two halves, mirroring the capability surface of the reference gateway
+(lightseekorg/smg, surveyed in /root/repo/SURVEY.md) but designed TPU-first:
+
+- ``smg_tpu.engine`` / ``smg_tpu.models`` / ``smg_tpu.ops`` / ``smg_tpu.parallel``:
+  an in-tree JAX/XLA/Pallas inference engine (continuous batching, paged KV
+  cache, radix prefix cache, tensor/data/sequence parallelism over a
+  ``jax.sharding.Mesh``).  The reference outsources this layer to external
+  CUDA engines behind ``grpc_servicer/`` (SURVEY.md §2.3); here it is native.
+
+- ``smg_tpu.gateway`` / ``smg_tpu.protocols`` / ``smg_tpu.policies``:
+  the model-routing gateway — OpenAI/Anthropic-compatible HTTP APIs,
+  cache-aware routing, worker registry/health/circuit-breakers, KV-event
+  driven prefix indexing (reference: ``model_gateway/src/``).
+"""
+
+from smg_tpu.version import __version__
+
+__all__ = ["__version__"]

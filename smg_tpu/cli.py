@@ -1,0 +1,72 @@
+"""CLI entry point: ``smg-tpu launch|serve|worker``.
+
+Reference: ``model_gateway/src/main.rs`` (``smg launch``) and the Python
+wrapper's ``launch``/``serve`` split (``bindings/python/src/smg/cli.py:1-50``):
+``launch`` starts the gateway only; ``serve`` starts engine worker(s) plus the
+gateway; ``worker`` starts a bare engine worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="smg-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    launch = sub.add_parser("launch", help="start the routing gateway")
+    _add_gateway_args(launch)
+
+    serve = sub.add_parser("serve", help="start TPU engine worker(s) + gateway")
+    _add_gateway_args(serve)
+    _add_engine_args(serve)
+
+    worker = sub.add_parser("worker", help="start a bare TPU engine worker (gRPC)")
+    _add_engine_args(worker)
+    worker.add_argument("--grpc-port", type=int, default=30001)
+
+    return p
+
+
+def _add_gateway_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("Gateway")
+    g.add_argument("--host", default="0.0.0.0")
+    g.add_argument("--port", type=int, default=30000)
+    g.add_argument("--worker", action="append", default=[], dest="workers",
+                   help="worker URL (repeatable)")
+    g.add_argument("--policy", default="cache_aware",
+                   help="routing policy (round_robin, random, cache_aware, least_load, "
+                        "power_of_two, prefix_hash, consistent_hashing, manual, bucket)")
+    g.add_argument("--max-concurrent-requests", type=int, default=256)
+    g.add_argument("--log-level", default="INFO")
+    g.add_argument("--prometheus-port", type=int, default=None)
+
+
+def _add_engine_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("Engine")
+    g.add_argument("--model-path", default=None, help="HF-format model dir")
+    g.add_argument("--model-preset", default=None, help="named preset (tiny, llama3-8b, ...)")
+    g.add_argument("--tokenizer-path", default=None)
+    g.add_argument("--tp", type=int, default=1, help="tensor parallel size")
+    g.add_argument("--dp", type=int, default=1, help="data parallel size")
+    g.add_argument("--max-batch-size", type=int, default=64)
+    g.add_argument("--max-seq-len", type=int, default=8192)
+    g.add_argument("--page-size", type=int, default=16)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from smg_tpu.utils.logging import configure
+
+    configure(level=getattr(args, "log_level", "INFO"))
+    if args.command in ("launch", "serve", "worker"):
+        from smg_tpu.gateway.launch import run_command
+
+        return run_command(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

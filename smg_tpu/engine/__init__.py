@@ -1,0 +1,17 @@
+"""The in-tree TPU inference engine.
+
+This package replaces the reference's worker-side stack — ``grpc_servicer/``
+plus the external CUDA engine it wraps (SURVEY.md §2.3, §3.3) — with a native
+JAX/XLA/Pallas engine: continuous-batching scheduler, paged KV cache, radix
+prefix cache with KV-event emission, bucketed jit execution, incremental
+detokenization and stop-sequence handling.
+"""
+
+from smg_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+)
+
+__all__ = ["CacheConfig", "EngineConfig", "ParallelConfig", "SchedulerConfig"]

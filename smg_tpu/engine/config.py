@@ -1,0 +1,116 @@
+"""Engine configuration.
+
+Reference analogue: engine-launch knobs forwarded by ``bindings/python/src/smg/serve.py:32-196``
+(tp size, memory fraction, ports) plus SGLang's own scheduler config.  Here the
+engine is in-tree so the config is first-class and validated.
+
+TPU-first design notes:
+- XLA compiles one program per distinct shape, so batch/seq sizes are drawn
+  from explicit bucket ladders (``prefill_token_buckets``, ``decode_batch_buckets``).
+- The KV cache is paged: ``page_size`` tokens per page, pages shared across
+  sequences via the radix prefix cache at page granularity.
+- Parallelism is declared as a mesh shape over named axes; shardings are
+  derived in ``smg_tpu.parallel.sharding``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh shape over named axes.
+
+    ``dp``: data parallel (replicated params, independent batches)
+    ``tp``: tensor parallel (heads / ffn sharded; collectives ride ICI)
+    ``sp``: sequence parallel for long-context prefill (ring attention)
+    ``ep``: expert parallel (MoE)
+    ``pp``: pipeline parallel (inter-slice / DCN)
+    """
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.tp * self.sp * self.ep * self.pp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"dp": self.dp, "tp": self.tp, "sp": self.sp, "ep": self.ep, "pp": self.pp}
+
+
+@dataclass(frozen=True)
+class CacheConfig:
+    """Paged KV cache layout.
+
+    ``page_size`` is in tokens.  TPU lane width is 128 and bf16 sublane packing
+    is 16, so head_dim stays a multiple of 128 and page_size a multiple of 8.
+    """
+
+    page_size: int = 16
+    num_pages: int = 2048  # overridden by hbm-based sizing when auto=True
+    auto_size: bool = True
+    hbm_utilization: float = 0.9  # fraction of free HBM given to KV after weights
+    dtype: str = "bfloat16"
+
+    def __post_init__(self) -> None:
+        if self.page_size % 8 != 0:
+            raise ValueError("page_size must be a multiple of 8 for TPU tiling")
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Continuous-batching scheduler knobs (token-budget interleaving of
+    prefill and decode — the reference relies on SGLang's scheduler for this;
+    ours is in-tree, SURVEY.md §7 step 2)."""
+
+    max_batch_size: int = 64  # decode slots
+    max_seq_len: int = 8192
+    max_prefill_tokens: int = 4096  # per prefill step (chunked prefill budget)
+    prefill_token_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
+    decode_batch_buckets: tuple[int, ...] = (8, 16, 32, 64)
+    schedule_policy: str = "fcfs"  # fcfs | priority
+    enable_prefix_cache: bool = True
+    watermark_pages: int = 8  # keep this many pages free before admitting prefill
+
+    def __post_init__(self) -> None:
+        if self.max_batch_size > max(self.decode_batch_buckets):
+            raise ValueError("max_batch_size must be <= largest decode batch bucket")
+        if self.max_prefill_tokens > max(self.prefill_token_buckets):
+            raise ValueError("max_prefill_tokens must be <= largest prefill bucket")
+
+    def prefill_bucket(self, n_tokens: int) -> int:
+        for b in self.prefill_token_buckets:
+            if n_tokens <= b:
+                return b
+        return max(self.prefill_token_buckets)
+
+    def decode_bucket(self, batch: int) -> int:
+        for b in self.decode_batch_buckets:
+            if batch <= b:
+                return b
+        return max(self.decode_batch_buckets)
+
+
+@dataclass
+class EngineConfig:
+    model: "object" = None  # smg_tpu.models.config.ModelConfig (untyped to avoid cycle)
+    model_path: str | None = None  # HF-format dir (config.json + safetensors)
+    tokenizer_path: str | None = None
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    dtype: str = "bfloat16"
+    seed: int = 0
+    # serving identity
+    model_id: str = "smg-tpu-model"
+    # profiling hook (reference: /start_profile proxying, common.proto:75-87)
+    profile_dir: str | None = None
+
+    def replace(self, **kw) -> "EngineConfig":
+        return dataclasses.replace(self, **kw)

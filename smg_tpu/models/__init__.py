@@ -1,0 +1,4 @@
+from smg_tpu.models.config import ModelConfig
+from smg_tpu.models.registry import get_model, register_model
+
+__all__ = ["ModelConfig", "get_model", "register_model"]

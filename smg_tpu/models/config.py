@@ -1,0 +1,129 @@
+"""Model architecture config, loadable from HF ``config.json``.
+
+The reference never loads models itself (engines do); for the in-tree TPU
+engine this is first-class.  Presets cover the BASELINE.md staged configs:
+Llama-3 1B/8B/70B class and a tiny test config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    arch: str = "llama"
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rope_scaling: dict | None = None
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    eos_token_ids: tuple[int, ...] = (128001, 128009)
+    bos_token_id: int = 128000
+    dtype: str = "bfloat16"
+    # MoE (0 = dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+
+    @classmethod
+    def from_hf_config(cls, cfg: dict, dtype: str = "bfloat16") -> "ModelConfig":
+        arch_names = cfg.get("architectures") or ["LlamaForCausalLM"]
+        arch = "llama"
+        name = arch_names[0].lower()
+        if "qwen3moe" in name or "qwen2moe" in name:
+            arch = "qwen_moe"
+        elif "qwen" in name:
+            arch = "qwen"
+        elif "mistral" in name:
+            arch = "llama"  # same architecture family
+        eos = cfg.get("eos_token_id", 2)
+        eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
+        num_heads = cfg["num_attention_heads"]
+        return cls(
+            arch=arch,
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg.get("intermediate_size", 4 * cfg["hidden_size"]),
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=num_heads,
+            num_kv_heads=cfg.get("num_key_value_heads", num_heads),
+            head_dim=cfg.get("head_dim") or cfg["hidden_size"] // num_heads,
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=cfg.get("rope_scaling"),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            eos_token_ids=eos_ids,
+            bos_token_id=cfg.get("bos_token_id", 1),
+            dtype=dtype,
+        )
+
+    @classmethod
+    def from_pretrained(cls, path: str, dtype: str = "bfloat16") -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f), dtype=dtype)
+
+
+# ---- presets (BASELINE.md staged configs) ----
+
+def tiny_test_config(vocab_size: int = 512) -> ModelConfig:
+    """Tiny model for CPU tests: 4 layers, GQA 8q/2kv, head_dim 16."""
+    return ModelConfig(
+        vocab_size=vocab_size,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        max_position_embeddings=2048,
+        eos_token_ids=(0,),
+        bos_token_id=1,
+        dtype="float32",
+    )
+
+
+def llama32_1b_config() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        rope_theta=500000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+                      "high_freq_factor": 4.0, "original_max_position_embeddings": 8192},
+        tie_word_embeddings=True,
+    )
+
+
+def llama3_8b_config() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0,
+    )
+
+
+def llama3_70b_config() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0,
+    )
+
+
+PRESETS = {
+    "tiny": tiny_test_config,
+    "llama3.2-1b": llama32_1b_config,
+    "llama3-8b": llama3_8b_config,
+    "llama3-70b": llama3_70b_config,
+}

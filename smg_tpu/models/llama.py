@@ -1,0 +1,247 @@
+"""Llama-family decoder (Llama 2/3/3.x, Mistral, Qwen2-dense) — functional JAX.
+
+Design (TPU-first, not a port):
+- Parameters are plain pytrees of stacked per-layer arrays (leading ``L`` axis)
+  and the layer stack is a single ``lax.scan`` — one compiled layer body
+  regardless of depth, fast XLA compiles, and pipeline-parallel friendly.
+- Every array carries *logical* sharding axes (``logical_axes``); actual
+  shardings come from ``smg_tpu.parallel.sharding.ShardingRules`` so
+  TP/DP/EP relayouts never touch this file.
+- KV cache is paged (``smg_tpu/ops/attention.py`` layout) and threaded through
+  the layer scan as xs/ys so jit donation can alias the buffers.
+
+Reference parity: serves the model families the reference routes to via
+SGLang/vLLM workers (SURVEY.md §0); the in-tree engine replaces that layer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from smg_tpu.models.config import ModelConfig
+from smg_tpu.ops.attention import (
+    attention_decode,
+    attention_prefill,
+    gather_seq_kv,
+    scatter_kv_pages,
+)
+from smg_tpu.ops.norms import rms_norm
+from smg_tpu.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init (serving weights normally come from safetensors loading;
+    random init backs tests and synthetic benches)."""
+    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, K, D, V = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.vocab_size
+    dtype = jnp.dtype(cfg.dtype)
+    ks = jax.random.split(key, 8)
+
+    def norm_init(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": norm_init(ks[0], (V, E), 0.02),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), dtype),
+            "wq": norm_init(ks[1], (L, E, H, D), 0.02),
+            "wk": norm_init(ks[2], (L, E, K, D), 0.02),
+            "wv": norm_init(ks[3], (L, E, K, D), 0.02),
+            "wo": norm_init(ks[4], (L, H, D, E), 0.02 / math.sqrt(2 * L)),
+            "mlp_norm": jnp.ones((L, E), dtype),
+            "w_gate": norm_init(ks[5], (L, E, F), 0.02),
+            "w_up": norm_init(ks[6], (L, E, F), 0.02),
+            "w_down": norm_init(ks[7], (L, F, E), 0.02 / math.sqrt(2 * L)),
+        },
+        "final_norm": jnp.ones((E,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm_init(jax.random.fold_in(key, 99), (E, V), 0.02)
+    return params
+
+
+def logical_axes(cfg: ModelConfig) -> Params:
+    """Pytree of logical-axis tuples matching ``init_params`` exactly."""
+    ax: Params = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "q_heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "q_heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "ffn"),
+            "w_up": ("layers", "embed", "ffn"),
+            "w_down": ("layers", "ffn", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_word_embeddings:
+        ax["lm_head"] = ("embed", "vocab")
+    return ax
+
+
+def kv_cache_logical_axes() -> tuple[str | None, ...]:
+    # [L, P, ps, K, D] — kv_heads sharded on tp, pages replicated per dp replica
+    return ("layers", "pages", None, "kv_heads", "head_dim")
+
+
+def embed_tokens(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    return params["embed"][tokens]
+
+
+def unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        return jnp.einsum("...e,ve->...v", h, params["embed"]).astype(jnp.float32)
+    return jnp.einsum("...e,ev->...v", h, params["lm_head"]).astype(jnp.float32)
+
+
+def _qkv(layer: Params, cfg: ModelConfig, h: jnp.ndarray):
+    q = jnp.einsum("...e,ehd->...hd", h, layer["wq"])
+    k = jnp.einsum("...e,ekd->...kd", h, layer["wk"])
+    v = jnp.einsum("...e,ekd->...kd", h, layer["wv"])
+    return q, k, v
+
+
+def _mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
+    gate = jnp.einsum("...e,ef->...f", h, layer["w_gate"])
+    up = jnp.einsum("...e,ef->...f", h, layer["w_up"])
+    return jnp.einsum("...f,fe->...e", jax.nn.silu(gate) * up, layer["w_down"])
+
+
+def forward_prefill(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [T] padded to bucket
+    prefix_len: jnp.ndarray,  # scalar: tokens already cached (radix hit)
+    t_real: jnp.ndarray,  # scalar: valid new tokens (<= T)
+    k_cache: jnp.ndarray,  # [L, P, ps, K, D]
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [mp] pages owned by this sequence
+):
+    """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache)."""
+    T = tokens.shape[0]
+    ps = k_cache.shape[2]
+    mp = page_table.shape[0]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    pos = prefix_len + jnp.arange(T)  # [T]
+    valid = jnp.arange(T) < t_real
+    pos_c = jnp.minimum(pos, mp * ps - 1)
+    dest = jnp.where(valid, page_table[pos_c // ps] * ps + pos_c % ps, 0)
+    ctx_len = prefix_len + t_real
+
+    h = embed_tokens(params, cfg, tokens)
+
+    def layer_body(h, xs):
+        layer, k_pages, v_pages = xs
+        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, hn)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        k_pages, v_pages = scatter_kv_pages(k_pages, v_pages, k, v, dest)
+        k_ctx, v_ctx = gather_seq_kv(k_pages, v_pages, page_table)
+        attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
+        h = h + jnp.einsum("thd,hde->te", attn, layer["wo"])
+        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp(layer, hn)
+        return h, (k_pages, v_pages)
+
+    h, (k_cache, v_cache) = jax.lax.scan(
+        layer_body, h, (params["layers"], k_cache, v_cache)
+    )
+    last = jnp.take_along_axis(
+        h, jnp.maximum(t_real - 1, 0)[None, None].astype(jnp.int32), axis=0
+    )[0]
+    logits = unembed(params, cfg, last)
+    return logits, k_cache, v_cache
+
+
+def forward_decode(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [B] one token per slot
+    positions: jnp.ndarray,  # [B] position of that token (= ctx_len - 1)
+    k_cache: jnp.ndarray,  # [L, P, ps, K, D]
+    v_cache: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, mp]; inactive rows all-zero -> garbage page
+):
+    """One decode step for the whole batch; returns (logits [B, V], caches)."""
+    B = tokens.shape[0]
+    ps = k_cache.shape[2]
+    mp = page_tables.shape[1]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    pos_c = jnp.minimum(positions, mp * ps - 1)
+    dest = jnp.take_along_axis(page_tables, (pos_c // ps)[:, None], axis=1)[:, 0] * ps + pos_c % ps
+
+    h = embed_tokens(params, cfg, tokens)  # [B, E]
+
+    def layer_body(h, xs):
+        layer, k_pages, v_pages = xs
+        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, hn)  # q: [B, H, D]
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        k_pages, v_pages = scatter_kv_pages(k_pages, v_pages, k, v, dest)
+        attn = attention_decode(q, k_pages, v_pages, page_tables, positions, scale)
+        h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
+        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp(layer, hn)
+        return h, (k_pages, v_pages)
+
+    h, (k_cache, v_cache) = jax.lax.scan(
+        layer_body, h, (params["layers"], k_cache, v_cache)
+    )
+    logits = unembed(params, cfg, h)  # [B, V]
+    return logits, k_cache, v_cache
+
+
+def forward_train(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [B, T]
+) -> jnp.ndarray:
+    """Dense causal forward for training / eval-logprobs: logits [B, T, V].
+
+    No KV cache; plain causal attention.  Used by the training utilities and
+    the multi-chip dry-run (full dp x tp x sp sharded step).
+    """
+    B, T = tokens.shape
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    pos = jnp.arange(T)[None, :].repeat(B, axis=0)
+    h = embed_tokens(params, cfg, tokens)
+
+    causal = jnp.tril(jnp.ones((T, T), bool))
+
+    def layer_body(h, layer):
+        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, hn)  # [B, T, H/K, D]
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        K = cfg.num_kv_heads
+        G = cfg.num_heads // K
+        qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
+        scores = jnp.where(causal[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+        attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
+        h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
+        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp(layer, hn)
+        return h, None
+
+    h, _ = jax.lax.scan(layer_body, h, params["layers"])
+    return unembed(params, cfg, h)

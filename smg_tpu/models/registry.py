@@ -1,0 +1,32 @@
+"""Model registry: architecture name -> model module.
+
+A model module exposes ``init_params``, ``logical_axes``, ``forward_prefill``,
+``forward_decode``, ``forward_train`` with the signatures in
+``smg_tpu/models/llama.py`` (the reference implementation of the contract).
+"""
+
+from __future__ import annotations
+
+from types import ModuleType
+
+_REGISTRY: dict[str, ModuleType] = {}
+
+
+def register_model(arch: str, module: ModuleType) -> None:
+    _REGISTRY[arch] = module
+
+
+def get_model(arch: str) -> ModuleType:
+    if arch not in _REGISTRY:
+        if arch in ("llama", "qwen", "mistral"):
+            from smg_tpu.models import llama
+
+            _REGISTRY.setdefault("llama", llama)
+            _REGISTRY.setdefault("qwen", llama)
+            _REGISTRY.setdefault("mistral", llama)
+        else:
+            raise KeyError(
+                f"unsupported model architecture: {arch!r} "
+                f"(registered: {sorted(_REGISTRY) or ['llama', 'qwen', 'mistral']})"
+            )
+    return _REGISTRY[arch]

@@ -1,0 +1,12 @@
+"""TPU compute ops.
+
+XLA-reference implementations plus Pallas kernels for the hot paths
+(flash attention for prefill, paged attention for decode).  Every Pallas
+kernel has an XLA fallback selected automatically on non-TPU backends so the
+full engine runs under CPU jax for tests (SURVEY.md §4 takeaway).
+"""
+
+from smg_tpu.ops.norms import rms_norm
+from smg_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["rms_norm", "apply_rope", "rope_frequencies"]

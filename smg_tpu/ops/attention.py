@@ -1,0 +1,103 @@
+"""Attention over the paged KV cache — XLA reference implementations.
+
+Layout (per layer): ``k_pages, v_pages: [num_pages, page_size, kv_heads, head_dim]``.
+Sequences own an ordered list of pages (``page_table``); the radix prefix cache
+shares page prefixes between sequences (``smg_tpu/engine/radix_cache.py``).
+Page 0 is reserved as a garbage page: padded/inactive tokens scatter there.
+
+Pallas TPU kernels for these two ops live in ``smg_tpu/ops/pallas/`` and are
+selected by ``smg_tpu.ops.dispatch`` on TPU backends; these XLA versions are
+the correctness reference and the CPU-test path (SURVEY.md §4 takeaway — the
+whole engine must run without TPU hardware).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def scatter_kv_pages(
+    k_pages: jnp.ndarray,  # [P, ps, K, D]
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [T, K, D]
+    v_new: jnp.ndarray,
+    dest_slots: jnp.ndarray,  # [T] flat slot index (page*ps + offset); 0..ps-1 => garbage page
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    P, ps, K, D = k_pages.shape
+    k_flat = k_pages.reshape(P * ps, K, D)
+    v_flat = v_pages.reshape(P * ps, K, D)
+    k_flat = k_flat.at[dest_slots].set(k_new.astype(k_flat.dtype))
+    v_flat = v_flat.at[dest_slots].set(v_new.astype(v_flat.dtype))
+    return k_flat.reshape(P, ps, K, D), v_flat.reshape(P, ps, K, D)
+
+
+def gather_seq_kv(
+    k_pages: jnp.ndarray,  # [P, ps, K, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [max_pages] page ids for one sequence
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize one sequence's KV contiguously: [max_pages*ps, K, D]."""
+    k = k_pages[page_table]  # [max_pages, ps, K, D]
+    v = v_pages[page_table]
+    mp, ps, K, D = k.shape
+    return k.reshape(mp * ps, K, D), v.reshape(mp * ps, K, D)
+
+
+def attention_prefill(
+    q: jnp.ndarray,  # [T, H, D] (new tokens, post-rope)
+    k_ctx: jnp.ndarray,  # [S, K, D] contiguous KV incl. prefix and new tokens
+    v_ctx: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [T] global positions of the new tokens
+    ctx_len: jnp.ndarray,  # scalar: total valid tokens in k_ctx
+    scale: float,
+) -> jnp.ndarray:
+    """Causal attention for one sequence's prefill chunk. GQA-aware."""
+    T, H, D = q.shape
+    S, K, _ = k_ctx.shape
+    G = H // K
+    qf = q.astype(jnp.float32).reshape(T, K, G, D)
+    kf = k_ctx.astype(jnp.float32)
+    vf = v_ctx.astype(jnp.float32)
+    scores = jnp.einsum("tkgd,skd->tkgs", qf, kf) * scale  # [T, K, G, S]
+    j = jnp.arange(S)
+    mask = (j[None, :] <= q_positions[:, None]) & (j[None, :] < ctx_len)  # [T, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgs,skd->tkgd", probs, vf)
+    return out.reshape(T, H, D).astype(q.dtype)
+
+
+def attention_decode(
+    q: jnp.ndarray,  # [B, H, D] one new token per sequence (post-rope)
+    k_pages: jnp.ndarray,  # [P, ps, K, D]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, max_pages]
+    positions: jnp.ndarray,  # [B] position of the new token (= ctx len - 1)
+    scale: float,
+) -> jnp.ndarray:
+    """Batched single-token attention over paged KV. GQA-aware.
+
+    XLA fallback: gathers each sequence's pages ([B, max_pages*ps, K, D]) and
+    does a masked softmax.  The Pallas kernel streams pages through VMEM
+    instead of materializing the gather.
+    """
+    B, H, D = q.shape
+    P, ps, K, _ = k_pages.shape
+    k = k_pages[page_tables]  # [B, mp, ps, K, D]
+    v = v_pages[page_tables]
+    mp = k.shape[1]
+    S = mp * ps
+    k = k.reshape(B, S, K, D).astype(jnp.float32)
+    v = v.reshape(B, S, K, D).astype(jnp.float32)
+    G = H // K
+    qf = q.astype(jnp.float32).reshape(B, K, G, D)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qf, k) * scale
+    j = jnp.arange(S)
+    mask = j[None, :] <= positions[:, None]  # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    return out.reshape(B, H, D).astype(q.dtype)

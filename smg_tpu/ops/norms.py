@@ -1,0 +1,15 @@
+"""Normalization ops.  RMSNorm computed in fp32 regardless of activation dtype
+(numerics matter more than the cast: XLA fuses the casts into the surrounding
+elementwise graph so this is bandwidth-free)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * (1.0 / jnp.sqrt(var + eps))
+    return (out * weight.astype(jnp.float32)).astype(dtype)

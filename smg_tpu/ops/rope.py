@@ -1,0 +1,47 @@
+"""Rotary position embeddings, including Llama-3 frequency scaling.
+
+Computed from positions at call time (positions are per-token arrays because
+continuous batching mixes sequences at different offsets in one step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(head_dim: int, theta: float, scaling: dict | None = None) -> np.ndarray:
+    """Inverse frequencies [head_dim/2], with optional llama3-style scaling."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = scaling.get("factor", 8.0)
+        low_factor = scaling.get("low_freq_factor", 1.0)
+        high_factor = scaling.get("high_freq_factor", 4.0)
+        old_ctx = scaling.get("original_max_position_embeddings", 8192)
+        low_wavelen = old_ctx / low_factor
+        high_wavelen = old_ctx / high_factor
+        wavelen = 2 * np.pi / inv_freq
+        scaled = np.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+        smooth = (old_ctx / wavelen - low_factor) / (high_factor - low_factor)
+        mid = (1 - smooth) * inv_freq / factor + smooth * inv_freq
+        is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        scaled = np.where(is_mid, mid, scaled)
+        inv_freq = scaled
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray
+) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: [..., T, H, D]  (D even; rotate-half convention, HF-compatible)
+    positions: broadcastable to [..., T]
+    inv_freq: [D/2]
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,4 @@
+from smg_tpu.parallel.mesh import MeshSpec, build_mesh
+from smg_tpu.parallel.sharding import ShardingRules, logical_to_sharding
+
+__all__ = ["MeshSpec", "build_mesh", "ShardingRules", "logical_to_sharding"]

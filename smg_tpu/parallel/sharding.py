@@ -1,0 +1,66 @@
+"""Logical-axis sharding rules.
+
+Every parameter and activation in ``smg_tpu.models`` is annotated with
+*logical* axis names ("vocab", "embed", "q_heads", "ffn", ...).  A
+``ShardingRules`` table maps logical axes to mesh axes; changing the table
+re-lays-out the whole model without touching model code.  This is the
+jax-idiomatic equivalent of the reference's per-engine ``tp_size`` passthrough
+(``bindings/python/src/smg/serve.py:54-57``) — but implemented, not delegated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """logical axis -> mesh axis (or None for replicated)."""
+
+    rules: dict = field(
+        default_factory=lambda: {
+            # params
+            "vocab": "tp",
+            "embed": None,
+            "q_heads": "tp",
+            "kv_heads": "tp",
+            "head_dim": None,
+            "ffn": "tp",
+            "experts": "ep",
+            "layers": None,
+            # activations / cache
+            "batch": "dp",
+            "seq": "sp",
+            "pages": None,
+            "act_embed": None,
+            "act_heads": "tp",
+        }
+    )
+
+    def mesh_axes(self, logical_axes: tuple[str | None, ...]) -> tuple[str | None, ...]:
+        out = []
+        for ax in logical_axes:
+            out.append(None if ax is None else self.rules.get(ax))
+        return tuple(out)
+
+
+def logical_to_spec(logical_axes: tuple[str | None, ...], rules: ShardingRules) -> P:
+    return P(*rules.mesh_axes(logical_axes))
+
+
+def logical_to_sharding(
+    logical_axes: tuple[str | None, ...], mesh: Mesh, rules: ShardingRules
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def tree_shardings(logical_tree, mesh: Mesh, rules: ShardingRules):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: logical_to_sharding(axes, mesh, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+    )

@@ -1,0 +1,36 @@
+"""API protocol types (reference: ``crates/protocols``, SURVEY.md §2.2).
+
+Pydantic models for every externally visible API shape: OpenAI chat/completions/
+embeddings, the native /generate API, sampling parameters, and KV-cache events.
+"""
+
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatCompletionStreamChunk,
+    ChatMessage,
+    CompletionRequest,
+    CompletionResponse,
+    EmbeddingRequest,
+    EmbeddingResponse,
+    ErrorResponse,
+    UsageInfo,
+)
+from smg_tpu.protocols.generate import GenerateRequest, GenerateResponse
+
+__all__ = [
+    "SamplingParams",
+    "ChatCompletionRequest",
+    "ChatCompletionResponse",
+    "ChatCompletionStreamChunk",
+    "ChatMessage",
+    "CompletionRequest",
+    "CompletionResponse",
+    "EmbeddingRequest",
+    "EmbeddingResponse",
+    "ErrorResponse",
+    "UsageInfo",
+    "GenerateRequest",
+    "GenerateResponse",
+]

@@ -1,0 +1,37 @@
+"""Test bootstrap: force an 8-device CPU jax so the whole engine — including
+multi-chip sharding — runs without TPU hardware (SURVEY.md §4 takeaway: mock
+workers + CPU-backed engine tests mirror the reference's GPU-free CI tiers).
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Some installs register an always-on TPU plugin that ignores JAX_PLATFORMS;
+# pin the default device to CPU so tests never touch real accelerators.
+try:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+except RuntimeError:
+    pass
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def tiny_cfg():
+    from smg_tpu.models.config import tiny_test_config
+
+    return tiny_test_config()

@@ -1,0 +1,80 @@
+import pytest
+
+from smg_tpu.protocols import (
+    ChatCompletionRequest,
+    ChatMessage,
+    CompletionRequest,
+    SamplingParams,
+)
+from smg_tpu.protocols.generate import GenerateRequest
+
+
+def test_sampling_defaults_valid():
+    sp = SamplingParams()
+    sp.validate()
+    assert not sp.is_greedy
+    assert SamplingParams(temperature=0.0).is_greedy
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        dict(top_p=0.0),
+        dict(top_p=1.5),
+        dict(top_k=0),
+        dict(temperature=-1.0),
+        dict(repetition_penalty=0.0),
+        dict(n=0),
+    ],
+)
+def test_sampling_rejects_invalid(bad):
+    with pytest.raises(ValueError):
+        SamplingParams(**bad).validate()
+
+
+def test_chat_request_to_sampling_params():
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="hi")],
+        temperature=0.5,
+        max_tokens=32,
+        stop="END",
+    )
+    sp = req.to_sampling_params(default_max_tokens=128)
+    assert sp.temperature == 0.5
+    assert sp.max_new_tokens == 32
+    assert sp.stop == ["END"]
+
+
+def test_chat_request_max_completion_tokens_wins():
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="hi")],
+        max_tokens=32,
+        max_completion_tokens=64,
+    )
+    assert req.to_sampling_params(10).max_new_tokens == 64
+
+
+def test_chat_request_tolerates_vendor_extensions():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "some_vendor_field": {"x": 1},
+        }
+    )
+    assert req.messages[0].content == "hi"
+
+
+def test_completion_request_default_max_tokens():
+    req = CompletionRequest(model="m", prompt="x", max_tokens=None)
+    assert req.to_sampling_params(99).max_new_tokens == 99
+
+
+def test_generate_request_sampling():
+    req = GenerateRequest.model_validate(
+        {"text": "hello", "sampling_params": {"max_new_tokens": 4, "temperature": 0.0}}
+    )
+    sp = req.to_sampling_params(128)
+    assert sp.is_greedy and sp.max_new_tokens == 4
