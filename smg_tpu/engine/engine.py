@@ -1,0 +1,255 @@
+"""Engine facade: scheduler + detokenization + stop strings + streaming.
+
+The worker-side entry point — what the reference reaches through
+``SGLangSchedulerServicer`` → ZMQ → external scheduler (SURVEY.md §3.3) is a
+direct in-process call here.  Token-level stops live in the scheduler; string
+stops need the tokenizer, so they live at this layer (matching the split in
+the reference, where the gateway's StreamingProcessor scans stop strings).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from smg_tpu.engine.config import EngineConfig
+from smg_tpu.engine.detokenize import IncrementalDecoder, StopStringChecker
+from smg_tpu.engine.events import KvEventPublisher
+from smg_tpu.engine.request import EngineRequest, RequestStatus, StepOutput
+from smg_tpu.engine.runner import ModelRunner
+from smg_tpu.engine.scheduler import Scheduler
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.utils import get_logger
+
+logger = get_logger("engine")
+
+
+@dataclass
+class RequestOutput:
+    """One streamed increment for a request (engine-level, post-detok)."""
+
+    rid: str
+    new_token_ids: list[int] = field(default_factory=list)
+    text_delta: str = ""
+    finished: bool = False
+    finish_reason: str | None = None
+    matched_stop: str | int | None = None
+    prompt_tokens: int = 0
+    output_tokens: int = 0
+    cached_tokens: int = 0
+    logprobs: list[float] = field(default_factory=list)
+
+
+@dataclass
+class GenerationResult:
+    rid: str
+    token_ids: list[int]
+    text: str
+    finish_reason: str
+    matched_stop: str | int | None
+    prompt_tokens: int
+    output_tokens: int
+    cached_tokens: int
+    logprobs: list[float]
+
+
+class Engine:
+    def __init__(self, config: EngineConfig, tokenizer=None, params=None, devices=None):
+        self.config = config
+        self.tokenizer = tokenizer
+        self.events = KvEventPublisher()
+        self.runner = ModelRunner(config, params=params, devices=devices)
+        self.scheduler = Scheduler(self.runner, config, event_sink=self.events.publish)
+        self._callbacks: dict[str, object] = {}
+        self._lock = threading.RLock()
+        self._wakeup = threading.Condition(self._lock)
+        self._thread: threading.Thread | None = None
+        self._stopping = False
+        self.start_time = time.monotonic()
+
+    # ---- submission ----
+
+    def submit(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        rid: str | None = None,
+        on_output=None,
+        priority: int = 0,
+    ) -> str:
+        rid = rid or f"req-{uuid.uuid4().hex[:16]}"
+        req = EngineRequest(
+            rid=rid, prompt_ids=list(prompt_ids), sampling=sampling, priority=priority
+        )
+        if self.tokenizer is not None:
+            req.detok = IncrementalDecoder(
+                self.tokenizer, skip_special_tokens=sampling.skip_special_tokens
+            )
+            if sampling.stop:
+                req.stop_checker = StopStringChecker(sampling.stop)
+        with self._wakeup:
+            self.scheduler.add_request(req)
+            if on_output is not None:
+                self._callbacks[rid] = on_output
+            self._wakeup.notify_all()
+        return rid
+
+    def abort(self, rid: str) -> bool:
+        with self._lock:
+            ok = self.scheduler.abort_request(rid)
+            self._callbacks.pop(rid, None)
+            return ok
+
+    def loads(self) -> dict:
+        with self._lock:
+            return self.scheduler.loads()
+
+    def flush_cache(self) -> bool:
+        with self._lock:
+            return self.scheduler.flush_cache()
+
+    # ---- stepping ----
+
+    def step(self) -> list[RequestOutput]:
+        """One scheduler iteration; returns per-request increments."""
+        with self._lock:
+            step_outs = self.scheduler.step()
+            outputs = [self._postprocess(so) for so in step_outs]
+            self.events.flush()
+        for out in outputs:
+            cb = self._callbacks.get(out.rid)
+            if cb is not None:
+                try:
+                    cb(out)
+                except Exception:
+                    logger.exception("output callback failed for %s", out.rid)
+                if out.finished:
+                    self._callbacks.pop(out.rid, None)
+        return outputs
+
+    def _postprocess(self, so: StepOutput) -> RequestOutput:
+        req = so.request
+        out = RequestOutput(
+            rid=req.rid,
+            new_token_ids=list(so.new_token_ids),
+            finished=so.finished,
+            finish_reason=so.finish.reason if so.finish else None,
+            matched_stop=so.finish.matched_stop if so.finish else None,
+            prompt_tokens=req.prompt_len,
+            output_tokens=len(req.output_ids),
+            cached_tokens=req.cached_tokens,
+            logprobs=req.logprobs[-len(so.new_token_ids):] if so.new_token_ids else [],
+        )
+        if req.detok is None:
+            return out
+        text = req.detok.put(so.new_token_ids) if so.new_token_ids else ""
+        if so.finished:
+            text += req.detok.flush()
+        if req.stop_checker is not None:
+            emitted, stopped = req.stop_checker.feed(text)
+            if stopped and not so.finished:
+                # found a stop string: finish now, trim held-back text
+                matched = req.stop_checker.matched
+                self.scheduler.finish_request(req.rid, "stop", matched_stop=matched)
+                out.finished = True
+                out.finish_reason = "stop"
+                out.matched_stop = matched
+            elif so.finished:
+                emitted += req.stop_checker.flush()
+            out.text_delta = emitted
+        else:
+            out.text_delta = text
+        return out
+
+    # ---- background loop ----
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop, name="smg-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._wakeup:
+            self._stopping = True
+            self._wakeup.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        logger.info("engine loop started")
+        while True:
+            with self._wakeup:
+                if self._stopping:
+                    break
+                if not self.scheduler.has_work():
+                    self._wakeup.wait(timeout=0.05)
+                    continue
+            try:
+                self.step()
+            except Exception:
+                logger.exception("engine step failed")
+                time.sleep(0.1)
+        logger.info("engine loop stopped")
+
+    # ---- sync convenience ----
+
+    def generate(
+        self,
+        prompt_ids: list[int] | None = None,
+        text: str | None = None,
+        sampling: SamplingParams | None = None,
+        rid: str | None = None,
+    ) -> GenerationResult:
+        """Blocking generate.  Drives the loop inline when no background
+        thread is running (tests), otherwise waits on the stream."""
+        sampling = sampling or SamplingParams()
+        if prompt_ids is None:
+            if text is None or self.tokenizer is None:
+                raise ValueError("need prompt_ids, or text with a tokenizer")
+            prompt_ids = self.tokenizer.encode(text)
+
+        done = threading.Event()
+        chunks: list[RequestOutput] = []
+
+        def on_output(out: RequestOutput) -> None:
+            chunks.append(out)
+            if out.finished:
+                done.set()
+
+        rid = self.submit(prompt_ids, sampling, rid=rid, on_output=on_output)
+        if self._thread is None:
+            deadline = time.monotonic() + 300
+            while not done.is_set():
+                self.step()
+                if time.monotonic() > deadline:
+                    self.abort(rid)
+                    raise TimeoutError(f"generation {rid} timed out")
+        else:
+            if not done.wait(timeout=300):
+                self.abort(rid)
+                raise TimeoutError(f"generation {rid} timed out")
+
+        token_ids: list[int] = []
+        logprobs: list[float] = []
+        text_out = []
+        last = chunks[-1]
+        for c in chunks:
+            token_ids.extend(c.new_token_ids)
+            logprobs.extend(c.logprobs)
+            text_out.append(c.text_delta)
+        return GenerationResult(
+            rid=rid,
+            token_ids=token_ids,
+            text="".join(text_out),
+            finish_reason=last.finish_reason or "stop",
+            matched_stop=last.matched_stop,
+            prompt_tokens=last.prompt_tokens,
+            output_tokens=last.output_tokens,
+            cached_tokens=chunks[0].cached_tokens if chunks else 0,
+            logprobs=logprobs,
+        )

@@ -1,0 +1,113 @@
+"""Paged KV cache: host-side page pool + device buffer creation.
+
+The device layout is ``[num_layers, num_pages, page_size, kv_heads, head_dim]``
+(see ``smg_tpu/ops/attention.py``).  Page 0 is reserved as the garbage page for
+padded/inactive writes, so the allocator never hands it out.
+
+Reference analogue: the external engines' KV allocators (SGLang's
+token-to-kv-pool); in-tree here because the TPU engine owns its memory.
+HBM sizing mirrors ``--mem-fraction-static``-style knobs forwarded by the
+reference's worker launcher (``bindings/python/src/smg/serve.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from smg_tpu.engine.config import CacheConfig
+from smg_tpu.models.config import ModelConfig
+
+
+class OutOfPagesError(RuntimeError):
+    pass
+
+
+class PagePool:
+    """Free-list page allocator.  Page 0 is the reserved garbage page."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfPagesError(f"requested {n} pages, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p == 0:
+                raise ValueError("page 0 is reserved and never allocated")
+            self._free.append(p)
+
+    def reset(self) -> None:
+        self._free = list(range(self.num_pages - 1, 0, -1))
+
+
+@dataclass
+class KvCacheSpec:
+    num_layers: int
+    num_pages: int
+    page_size: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.num_layers, self.num_pages, self.page_size, self.num_kv_heads, self.head_dim)
+
+    @property
+    def bytes_per_page(self) -> int:
+        # k + v, all layers
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.page_size * self.num_kv_heads * self.head_dim * itemsize
+
+
+def plan_cache(
+    model: ModelConfig,
+    cache: CacheConfig,
+    hbm_bytes_free: int | None = None,
+    param_bytes: int = 0,
+    tp: int = 1,
+) -> KvCacheSpec:
+    """Decide num_pages.  With ``auto_size`` and a known HBM budget, fill the
+    headroom left after weights; otherwise use the configured num_pages."""
+    num_pages = cache.num_pages
+    spec = KvCacheSpec(
+        num_layers=model.num_layers,
+        num_pages=num_pages,
+        page_size=cache.page_size,
+        num_kv_heads=max(model.num_kv_heads // tp, 1),
+        head_dim=model.head_dim,
+        dtype=cache.dtype,
+    )
+    if cache.auto_size and hbm_bytes_free is not None:
+        budget = int(hbm_bytes_free * cache.hbm_utilization) - param_bytes
+        per_page = spec.bytes_per_page
+        auto_pages = max(budget // per_page, 16)
+        spec.num_pages = int(auto_pages)
+    return spec
+
+
+def create_kv_buffers(spec: KvCacheSpec, sharding=None) -> tuple[jax.Array, jax.Array]:
+    """Allocate zeroed K and V buffers (optionally with a NamedSharding)."""
+    shape = spec.shape
+    dtype = jnp.dtype(spec.dtype)
+    if sharding is not None:
+        zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=(sharding))
+        k = zeros()
+        v = zeros()
+    else:
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+    return k, v

@@ -1,0 +1,75 @@
+"""Engine-side request state for continuous batching."""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from smg_tpu.protocols.sampling import SamplingParams
+
+
+class RequestStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+    ABORTED = "aborted"
+
+
+@dataclass
+class FinishInfo:
+    reason: str  # "stop" | "length" | "abort" | "error"
+    matched_stop: str | int | None = None
+    message: str | None = None
+
+
+@dataclass
+class EngineRequest:
+    rid: str
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    arrival_time: float = field(default_factory=time.monotonic)
+    priority: int = 0
+
+    # runtime
+    status: RequestStatus = RequestStatus.WAITING
+    output_ids: list[int] = field(default_factory=list)
+    logprobs: list[float] = field(default_factory=list)
+    seq_len: int = 0  # tokens whose KV is currently cached
+    cached_tokens: int = 0  # tokens served from the radix prefix cache
+    owned_pages: list[int] = field(default_factory=list)  # pages this request owns
+    shared_pages: list[int] = field(default_factory=list)  # radix-cache pages (pinned)
+    radix_node: Any = None  # locked RadixNode for the shared prefix
+    slot: int | None = None  # decode slot index
+    finish: FinishInfo | None = None
+    # filled by the engine layer (detokenize/stop strings)
+    detok: Any = None
+    stop_checker: Any = None
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.prompt_ids + self.output_ids
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status in (RequestStatus.FINISHED, RequestStatus.ABORTED)
+
+
+@dataclass
+class StepOutput:
+    """One request's increment from a scheduler step."""
+
+    request: EngineRequest
+    new_token_ids: list[int]
+    finished: bool
+    finish: FinishInfo | None = None

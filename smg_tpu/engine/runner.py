@@ -1,0 +1,218 @@
+"""ModelRunner: owns params, KV buffers, and the bucketed jit step cache.
+
+TPU execution model (SURVEY.md §7 hard part a): XLA compiles one program per
+shape, so prefill lengths and decode batch sizes are drawn from fixed bucket
+ladders; the runner pads to the bucket, compiles on first use, and donates the
+KV buffers every step so updates alias in place.
+
+Parallelism: params/caches carry NamedShardings derived from the model's
+logical axes (``smg_tpu/parallel/sharding.py``); GSPMD partitions the step
+functions and inserts ICI collectives.  Single-device runs skip sharding.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smg_tpu.engine.config import EngineConfig
+from smg_tpu.engine.kv_cache import KvCacheSpec, create_kv_buffers, plan_cache
+from smg_tpu.engine.sampling import sample_tokens
+from smg_tpu.models.registry import get_model
+from smg_tpu.ops.rope import rope_frequencies
+from smg_tpu.parallel.mesh import build_mesh
+from smg_tpu.parallel.sharding import ShardingRules, logical_to_sharding, tree_shardings
+from smg_tpu.utils import get_logger
+
+logger = get_logger("engine.runner")
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params=None,
+        devices: list | None = None,
+    ):
+        self.config = config
+        self.model_cfg = config.model
+        self.module = get_model(self.model_cfg.arch)
+        self.rules = ShardingRules()
+
+        world = config.parallel.world_size
+        self.mesh = build_mesh(config.parallel, devices=devices) if world > 1 else None
+
+        self.inv_freq = jnp.asarray(
+            rope_frequencies(
+                self.model_cfg.head_dim, self.model_cfg.rope_theta, self.model_cfg.rope_scaling
+            )
+        )
+
+        key = jax.random.PRNGKey(config.seed)
+        if params is not None:
+            self.params = params
+        elif self.mesh is not None:
+            shardings = tree_shardings(
+                self.module.logical_axes(self.model_cfg), self.mesh, self.rules
+            )
+            self.params = jax.jit(
+                partial(self.module.init_params, self.model_cfg), out_shardings=shardings
+            )(key)
+        else:
+            self.params = jax.jit(partial(self.module.init_params, self.model_cfg))(key)
+
+        # KV cache sizing + buffers
+        param_bytes = sum(x.nbytes for x in jax.tree.leaves(self.params))
+        hbm_free = self._detect_hbm()
+        self.spec: KvCacheSpec = plan_cache(
+            self.model_cfg, config.cache, hbm_free, param_bytes, tp=1
+        )
+        # bound pages so the fallback gather in tests stays small
+        kv_sharding = None
+        if self.mesh is not None:
+            from smg_tpu.models.llama import kv_cache_logical_axes
+
+            kv_sharding = logical_to_sharding(kv_cache_logical_axes(), self.mesh, self.rules)
+            self._replicated = logical_to_sharding((), self.mesh, self.rules)
+        else:
+            self._replicated = None
+        self.kv_sharding = kv_sharding
+        self.k_cache, self.v_cache = create_kv_buffers(self.spec, kv_sharding)
+        logger.info(
+            "kv cache: %d pages x %d tokens (%.1f MiB)",
+            self.spec.num_pages,
+            self.spec.page_size,
+            self.spec.num_pages * self.spec.bytes_per_page / 2**20,
+        )
+
+        self.max_pages_per_seq = math.ceil(
+            config.scheduler.max_seq_len / config.cache.page_size
+        )
+        self._rng_key = jax.random.PRNGKey(config.seed ^ 0x5EED)
+        self._step = 0
+        self._compiled: dict = {}
+
+    def _detect_hbm(self) -> int | None:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                return stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+        except Exception:
+            pass
+        return None
+
+    # ---- step function construction ----
+
+    def _next_key(self):
+        self._step += 1
+        return jax.random.fold_in(self._rng_key, self._step)
+
+    def _prefill_fn(self, T: int, mp: int):
+        k = ("prefill", T, mp)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+
+        def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
+                 key, temp, topk, topp, minp):
+            logits, kc, vc = module.forward_prefill(
+                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table
+            )
+            toks, lps = sample_tokens(logits[None], key, temp, topk, topp, minp)
+            return toks[0], lps[0], kc, vc
+
+        fn = jax.jit(step, donate_argnums=(5, 6))
+        self._compiled[k] = fn
+        return fn
+
+    def _decode_fn(self, B: int, mp: int):
+        k = ("decode", B, mp)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+
+        def step(params, inv_freq, tokens, positions, kc, vc, page_tables,
+                 key, temps, topks, topps, minps):
+            logits, kc, vc = module.forward_decode(
+                params, cfg, inv_freq, tokens, positions, kc, vc, page_tables
+            )
+            toks, lps = sample_tokens(logits, key, temps, topks, topps, minps)
+            return toks, lps, kc, vc
+
+        fn = jax.jit(step, donate_argnums=(4, 5))
+        self._compiled[k] = fn
+        return fn
+
+    # ---- host-facing API ----
+
+    def prefill(
+        self,
+        token_ids: list[int],
+        prefix_len: int,
+        page_table: np.ndarray,  # [<= max_pages_per_seq] int32
+        temperature: float,
+        top_k: int,
+        top_p: float,
+        min_p: float,
+    ) -> tuple[int, float]:
+        """Run one prefill chunk; returns (sampled_token, logprob)."""
+        t = len(token_ids)
+        T = self.config.scheduler.prefill_bucket(t)
+        tokens = np.zeros(T, np.int32)
+        tokens[:t] = token_ids
+        mp = len(page_table)
+        fn = self._prefill_fn(T, mp)
+        tok, lp, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.inv_freq,
+            jnp.asarray(tokens),
+            jnp.int32(prefix_len),
+            jnp.int32(t),
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(page_table, jnp.int32),
+            self._next_key(),
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+            jnp.asarray([min_p], jnp.float32),
+        )
+        return int(tok), float(lp)
+
+    def decode(
+        self,
+        tokens: np.ndarray,  # [B] int32
+        positions: np.ndarray,  # [B] int32
+        page_tables: np.ndarray,  # [B, mp] int32
+        temps: np.ndarray,
+        topks: np.ndarray,
+        topps: np.ndarray,
+        minps: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        B, mp = page_tables.shape
+        fn = self._decode_fn(B, mp)
+        toks, lps, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.inv_freq,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(page_tables, jnp.int32),
+            self._next_key(),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topks, jnp.int32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(minps, jnp.float32),
+        )
+        return np.asarray(toks), np.asarray(lps)
+
+    def flush_cache_buffers(self) -> None:
+        """Zero the KV buffers (used by flush_cache after the radix reset)."""
+        self.k_cache, self.v_cache = create_kv_buffers(self.spec, self.kv_sharding)

@@ -1,0 +1,76 @@
+"""On-device batched sampling: temperature / top-k / top-p / min-p, greedy mix.
+
+One fused function over the whole decode batch with per-slot parameter arrays
+(continuous batching mixes requests with different sampling configs in one
+step).  Wire-parity with the reference's ``SamplingParams``
+(``sglang_scheduler.proto:67-101``); implementation is TPU-first: fixed
+shapes, no data-dependent control flow, gumbel-argmax sampling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] (0 => greedy)
+    top_k: jnp.ndarray,  # [B] int32 (-1 => disabled)
+    top_p: jnp.ndarray,  # [B] (1.0 => disabled)
+    min_p: jnp.ndarray,  # [B] (0.0 => disabled)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens [B] int32, logprobs [B] float32 of the chosen token
+    under the *unfiltered* distribution — OpenAI logprob semantics)."""
+    B, V = logits.shape
+    greedy = temperature <= 0.0
+    safe_temp = jnp.where(greedy, 1.0, temperature)
+    z = logits / safe_temp[:, None]
+
+    # top-k via ranks (full argsort: exact; TODO pallas/top-k fast path)
+    order = jnp.argsort(-z, axis=-1)  # [B, V] token ids, desc
+    ranks = jnp.argsort(order, axis=-1)  # rank of each token id
+    k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)
+    z = jnp.where(ranks < k_eff[:, None], z, NEG_INF)
+
+    # top-p (nucleus) on the filtered dist; exclusive cumsum keeps top-1 always
+    probs = jax.nn.softmax(z, axis=-1)
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+    cum_excl = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+    keep_sorted = cum_excl < top_p[:, None]
+    keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+    z = jnp.where(keep, z, NEG_INF)
+
+    # min-p: drop tokens below min_p * max_prob
+    probs = jax.nn.softmax(z, axis=-1)
+    max_prob = probs.max(axis=-1, keepdims=True)
+    z = jnp.where(probs >= min_p[:, None] * max_prob, z, NEG_INF)
+
+    g = jax.random.gumbel(key, z.shape, jnp.float32)
+    sampled = jnp.argmax(z + g, axis=-1)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    tokens = jnp.where(greedy, greedy_tok, sampled).astype(jnp.int32)
+
+    all_logprobs = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(all_logprobs, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return tokens, chosen
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V]
+    output_counts: jnp.ndarray,  # [B, V] int32: count of each token in the output so far
+    prompt_mask: jnp.ndarray,  # [B, V] bool: token appeared in prompt
+    frequency_penalty: jnp.ndarray,  # [B]
+    presence_penalty: jnp.ndarray,  # [B]
+    repetition_penalty: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """OpenAI frequency/presence penalties + HF-style repetition penalty."""
+    logits = logits - frequency_penalty[:, None] * output_counts
+    logits = logits - presence_penalty[:, None] * (output_counts > 0)
+    seen = (output_counts > 0) | prompt_mask
+    rp = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    return jnp.where(seen, penalized, logits)

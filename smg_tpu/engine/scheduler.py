@@ -1,0 +1,350 @@
+"""Continuous-batching scheduler: token-budget prefill/decode interleaving,
+radix prefix reuse, page accounting with evict-then-preempt back-pressure.
+
+This is the in-tree replacement for the scheduler the reference delegates to
+SGLang behind ZMQ (``grpc_servicer/.../request_manager.py:48-65``, SURVEY.md
+§3.3) — redesigned for XLA: every device step is a fixed-shape bucketed call
+into ``ModelRunner``; all bookkeeping (pages, slots, stops) lives host-side.
+
+Step shape: admit waiting requests (prefill, chunked under
+``max_prefill_tokens``), then one decode step for every running slot.
+Prefill-priority keeps TTFT low; decode keeps slots saturated.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from smg_tpu.engine.config import EngineConfig
+from smg_tpu.engine.kv_cache import PagePool
+from smg_tpu.engine.radix_cache import RadixCache
+from smg_tpu.engine.request import (
+    EngineRequest,
+    FinishInfo,
+    RequestStatus,
+    StepOutput,
+)
+from smg_tpu.engine.runner import ModelRunner
+from smg_tpu.utils import get_logger
+
+logger = get_logger("engine.scheduler")
+
+
+class Scheduler:
+    def __init__(
+        self,
+        runner: ModelRunner,
+        config: EngineConfig,
+        event_sink: Callable | None = None,
+    ):
+        self.runner = runner
+        self.config = config
+        self.sched = config.scheduler
+        self.ps = runner.spec.page_size
+        self.mp = runner.max_pages_per_seq
+        self.pool = PagePool(runner.spec.num_pages)
+        self.radix = (
+            RadixCache(self.ps, event_sink) if self.sched.enable_prefix_cache else None
+        )
+        self.waiting: deque[EngineRequest] = deque()
+        self.slots: list[EngineRequest | None] = [None] * self.sched.max_batch_size
+        self.page_tables = np.zeros((self.sched.max_batch_size, self.mp), np.int32)
+        self.requests: dict[str, EngineRequest] = {}
+        # counters for GetLoads / metrics
+        self.num_prefill_tokens = 0
+        self.num_decode_tokens = 0
+        self.num_preemptions = 0
+
+    # ---- public API ----
+
+    def add_request(self, req: EngineRequest) -> None:
+        if req.rid in self.requests:
+            raise ValueError(f"duplicate request id {req.rid}")
+        self.requests[req.rid] = req
+        self.waiting.append(req)
+
+    def abort_request(self, rid: str) -> bool:
+        req = self.requests.get(rid)
+        if req is None or req.is_finished:
+            return False
+        if req.status == RequestStatus.WAITING or req.status == RequestStatus.PREEMPTED:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+            req.status = RequestStatus.ABORTED
+            req.finish = FinishInfo(reason="abort")
+            self.requests.pop(rid, None)
+            return True
+        self._release(req, FinishInfo(reason="abort"), aborted=True)
+        return True
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def loads(self) -> dict:
+        running = sum(1 for s in self.slots if s is not None)
+        return {
+            "num_waiting": len(self.waiting),
+            "num_running": running,
+            "free_pages": self.pool.free_count,
+            "cached_pages": self.radix.num_cached_pages if self.radix else 0,
+            "total_pages": self.runner.spec.num_pages,
+        }
+
+    def flush_cache(self) -> bool:
+        """Drop the prefix cache (only when idle, like the reference engines)."""
+        if any(s is not None for s in self.slots) or self.waiting:
+            return False
+        if self.radix:
+            self.pool.free(self.radix.clear())
+        self.runner.flush_cache_buffers()
+        return True
+
+    # ---- the step ----
+
+    def step(self) -> list[StepOutput]:
+        outputs: list[StepOutput] = []
+        self._admit(outputs)
+        self._decode(outputs)
+        return outputs
+
+    # ---- admission / prefill ----
+
+    def _admit(self, outputs: list[StepOutput]) -> None:
+        while self.waiting:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                return
+            req = self.waiting[0]
+            prompt = req.all_token_ids  # includes prior output after preemption
+            if len(prompt) + 1 > self.sched.max_seq_len:
+                self.waiting.popleft()
+                req.status = RequestStatus.FINISHED
+                req.finish = FinishInfo(
+                    reason="error",
+                    message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
+                )
+                outputs.append(StepOutput(req, [], True, req.finish))
+                continue
+            if req.sampling.max_new_tokens == 0:
+                self.waiting.popleft()
+                req.status = RequestStatus.FINISHED
+                req.finish = FinishInfo(reason="length")
+                outputs.append(StepOutput(req, [], True, req.finish))
+                continue
+
+            # radix prefix match (never match the full prompt: at least one
+            # token must be computed to produce logits)
+            shared_pages: list[int] = []
+            node = None
+            if self.radix is not None:
+                shared_pages, node = self.radix.match_prefix(prompt[:-1])
+            matched_tokens = len(shared_pages) * self.ps
+            prompt_pages_total = math.ceil(len(prompt) / self.ps)
+            need = prompt_pages_total - len(shared_pages)
+
+            if not self._ensure_free_pages(need + self.sched.watermark_pages):
+                return  # back-pressure: wait for pages
+
+            self.waiting.popleft()
+            if node is not None:
+                self.radix.lock(node)
+            req.radix_node = node
+            req.shared_pages = shared_pages
+            req.cached_tokens = matched_tokens
+            req.owned_pages = self.pool.alloc(need)
+            req.status = RequestStatus.RUNNING
+
+            slot = free_slots[0]
+            req.slot = slot
+            row = self.page_tables[slot]
+            row[:] = 0
+            all_pages = shared_pages + req.owned_pages
+            row[: len(all_pages)] = all_pages
+
+            # chunked prefill
+            start = matched_tokens
+            sp = req.sampling
+            tok = lp = None
+            while start < len(prompt):
+                chunk = prompt[start : start + self.sched.max_prefill_tokens]
+                tok, lp = self.runner.prefill(
+                    chunk,
+                    prefix_len=start,
+                    page_table=row,
+                    temperature=sp.temperature,
+                    top_k=sp.top_k,
+                    top_p=sp.top_p,
+                    min_p=sp.min_p,
+                )
+                self.num_prefill_tokens += len(chunk)
+                start += len(chunk)
+            req.seq_len = len(prompt)
+            self.slots[slot] = req
+            self._append_token(req, tok, lp, outputs)
+
+    def _ensure_free_pages(self, n: int) -> bool:
+        if self.pool.free_count >= n:
+            return True
+        if self.radix is not None:
+            freed = self.radix.evict(n - self.pool.free_count)
+            if freed:
+                self.pool.free(freed)
+        return self.pool.free_count >= n
+
+    # ---- decode ----
+
+    def _decode(self, outputs: list[StepOutput]) -> None:
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return
+        # ensure a page exists for each slot's next KV write; may preempt
+        survivors = []
+        for i, req in active:
+            if self._ensure_seq_capacity(req):
+                survivors.append((i, req))
+        active = [(i, r) for i, r in survivors if self.slots[i] is r]
+        if not active:
+            return
+
+        B_real = len(active)
+        B = self.sched.decode_bucket(B_real)
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        page_tables = np.zeros((B, self.mp), np.int32)
+        temps = np.zeros(B, np.float32)
+        topks = np.full(B, -1, np.int32)
+        topps = np.ones(B, np.float32)
+        minps = np.zeros(B, np.float32)
+        for idx, (slot, req) in enumerate(active):
+            tokens[idx] = req.output_ids[-1]
+            positions[idx] = req.seq_len
+            page_tables[idx] = self.page_tables[slot]
+            sp = req.sampling
+            temps[idx] = sp.temperature
+            topks[idx] = sp.top_k
+            topps[idx] = sp.top_p
+            minps[idx] = sp.min_p
+
+        toks, lps = self.runner.decode(
+            tokens, positions, page_tables, temps, topks, topps, minps
+        )
+        self.num_decode_tokens += B_real
+        for idx, (slot, req) in enumerate(active):
+            req.seq_len += 1
+            self._append_token(req, int(toks[idx]), float(lps[idx]), outputs)
+
+    def _ensure_seq_capacity(self, req: EngineRequest) -> bool:
+        """Make sure a page exists for position ``req.seq_len``.  Returns False
+        if the request had to be preempted."""
+        needed = math.ceil((req.seq_len + 1) / self.ps)
+        have = len(req.shared_pages) + len(req.owned_pages)
+        if needed <= have:
+            return True
+        if not self._ensure_free_pages(1):
+            victim = self._pick_preemption_victim(req)
+            if victim is None:
+                # nothing else to preempt: preempt this request itself
+                self._preempt(req)
+                return False
+            self._preempt(victim)
+            if not self._ensure_free_pages(1):
+                self._preempt(req)
+                return False
+        page = self.pool.alloc(1)[0]
+        req.owned_pages.append(page)
+        self.page_tables[req.slot][needed - 1] = page
+        return True
+
+    def _pick_preemption_victim(self, requester: EngineRequest) -> EngineRequest | None:
+        candidates = [
+            r for r in self.slots if r is not None and r is not requester
+        ]
+        if not candidates:
+            return None
+        # youngest first (FCFS fairness: latest arrival pays)
+        return max(candidates, key=lambda r: r.arrival_time)
+
+    def _preempt(self, req: EngineRequest) -> None:
+        logger.warning("preempting request %s (out of KV pages)", req.rid)
+        self.num_preemptions += 1
+        slot = req.slot
+        self.slots[slot] = None
+        self.page_tables[slot][:] = 0
+        req.slot = None
+        self.pool.free(req.owned_pages)
+        req.owned_pages = []
+        req.shared_pages = []
+        if req.radix_node is not None:
+            self.radix.unlock(req.radix_node)
+            req.radix_node = None
+        req.seq_len = 0
+        req.cached_tokens = 0
+        req.status = RequestStatus.PREEMPTED
+        self.waiting.appendleft(req)
+
+    # ---- finish bookkeeping ----
+
+    def _append_token(
+        self, req: EngineRequest, tok: int, lp: float, outputs: list[StepOutput]
+    ) -> None:
+        req.output_ids.append(tok)
+        req.logprobs.append(lp)
+        sp = req.sampling
+        finish: FinishInfo | None = None
+        if not sp.ignore_eos and tok in self.config.model.eos_token_ids:
+            finish = FinishInfo(reason="stop", matched_stop=tok)
+        elif tok in sp.stop_token_ids:
+            finish = FinishInfo(reason="stop", matched_stop=tok)
+        elif len(req.output_ids) >= sp.max_new_tokens:
+            finish = FinishInfo(reason="length")
+        elif req.total_len >= self.sched.max_seq_len:
+            finish = FinishInfo(reason="length")
+        if finish is not None:
+            self._release(req, finish)
+        outputs.append(StepOutput(req, [tok], finish is not None, finish))
+
+    def finish_request(self, rid: str, reason: str, matched_stop=None) -> None:
+        """External finish (e.g. the engine found a stop string)."""
+        req = self.requests.get(rid)
+        if req is None or req.is_finished or req.slot is None:
+            return
+        self._release(req, FinishInfo(reason=reason, matched_stop=matched_stop))
+
+    def _release(
+        self, req: EngineRequest, finish: FinishInfo, aborted: bool = False
+    ) -> None:
+        req.finish = finish
+        req.status = RequestStatus.ABORTED if aborted else RequestStatus.FINISHED
+        if req.slot is not None:
+            self.page_tables[req.slot][:] = 0
+            self.slots[req.slot] = None
+            req.slot = None
+
+        tokens = req.all_token_ids
+        full_pages = len(tokens) // self.ps
+        n_shared = len(req.shared_pages)
+        to_free: list[int] = []
+        if self.radix is not None and finish.reason != "error":
+            all_pages = req.shared_pages + req.owned_pages
+            dupes = self.radix.insert(tokens, all_pages[:full_pages])
+            for idx, page in dupes:
+                if idx >= n_shared:
+                    to_free.append(page)
+            # partial tail page(s) stay ours -> free
+            to_free.extend(all_pages[full_pages:])
+        else:
+            to_free.extend(req.owned_pages)
+        if to_free:
+            self.pool.free(to_free)
+        req.owned_pages = []
+        req.shared_pages = []
+        if req.radix_node is not None:
+            self.radix.unlock(req.radix_node)
+            req.radix_node = None
+        self.requests.pop(req.rid, None)

@@ -1,0 +1,7 @@
+"""Tokenization layer (reference: ``crates/tokenizer``, SURVEY.md §2.2):
+HF tokenizers, chat templating, incremental decode, and a MockTokenizer for
+hardware-free tests (reference: ``crates/tokenizer/src/mock.rs``)."""
+
+from smg_tpu.tokenizer.mock import MockTokenizer
+
+__all__ = ["MockTokenizer"]

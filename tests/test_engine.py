@@ -1,0 +1,181 @@
+"""End-to-end engine tests on CPU: continuous batching, prefix cache,
+stop handling, page-pressure preemption."""
+
+import pytest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def make_engine(num_pages=128, max_batch=8, max_seq_len=256, **sched_kw) -> Engine:
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=num_pages, auto_size=False, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=max_batch,
+            max_seq_len=max_seq_len,
+            max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64),
+            decode_batch_buckets=(4, 8),
+            **sched_kw,
+        ),
+        dtype="float32",
+    )
+    return Engine(cfg, tokenizer=MockTokenizer())
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return make_engine()
+
+
+def greedy(max_new=8, **kw) -> SamplingParams:
+    return SamplingParams(temperature=0.0, max_new_tokens=max_new, ignore_eos=True, **kw)
+
+
+def test_basic_generate(engine):
+    res = engine.generate(prompt_ids=list(range(5, 25)), sampling=greedy(8))
+    assert len(res.token_ids) == 8
+    assert res.finish_reason == "length"
+    assert res.prompt_tokens == 20
+    assert res.output_tokens == 8
+    assert res.text  # detokenized via MockTokenizer
+
+
+def test_greedy_deterministic_and_prefix_cached(engine):
+    prompt = list(range(30, 70))  # 40 tokens
+    r1 = engine.generate(prompt_ids=prompt, sampling=greedy(6))
+    r2 = engine.generate(prompt_ids=prompt, sampling=greedy(6))
+    assert r1.token_ids == r2.token_ids
+    assert r1.cached_tokens == 0
+    # 40 tokens -> 2 full pages cached; match capped at prompt_len-1 => 32
+    assert r2.cached_tokens == 32
+
+
+def test_prefix_cache_does_not_change_output(engine):
+    prompt = list(range(100, 180))  # 80 tokens
+    r1 = engine.generate(prompt_ids=prompt, sampling=greedy(10))
+    r2 = engine.generate(prompt_ids=prompt, sampling=greedy(10))
+    assert r2.cached_tokens > 0
+    assert r1.token_ids == r2.token_ids
+
+
+def test_stop_token_ids(engine):
+    probe = engine.generate(prompt_ids=list(range(5, 15)), sampling=greedy(4))
+    stop_tok = probe.token_ids[2]
+    res = engine.generate(
+        prompt_ids=list(range(5, 15)),
+        sampling=SamplingParams(
+            temperature=0.0, max_new_tokens=16, ignore_eos=True, stop_token_ids=[stop_tok]
+        ),
+    )
+    assert res.finish_reason == "stop"
+    assert res.matched_stop == stop_tok
+    assert res.token_ids[-1] == stop_tok
+    assert len(res.token_ids) == 3
+
+
+def test_stop_string(engine):
+    probe = engine.generate(prompt_ids=list(range(40, 50)), sampling=greedy(6))
+    # the mock tokenizer renders token i as "w{i}"; stop on the 3rd token's text
+    stop_word = f"w{probe.token_ids[2]}"
+    res = engine.generate(
+        prompt_ids=list(range(40, 50)),
+        sampling=SamplingParams(
+            temperature=0.0, max_new_tokens=16, ignore_eos=True, stop=[stop_word]
+        ),
+    )
+    assert res.finish_reason == "stop"
+    assert res.matched_stop == stop_word
+    assert stop_word not in res.text
+    assert len(res.token_ids) < 16
+
+
+def test_concurrent_requests_interleave(engine):
+    results = {}
+    rids = []
+    for i in range(6):
+        prompt = list(range(10 + i * 7, 30 + i * 7))
+        rid = engine.submit(
+            prompt, greedy(5 + i % 3), on_output=lambda o, i=i: results.setdefault(i, []).append(o)
+        )
+        rids.append(rid)
+    for _ in range(200):
+        engine.step()
+        if len([k for k, v in results.items() if v and v[-1].finished]) == 6:
+            break
+    assert all(results[i][-1].finished for i in range(6))
+    for i in range(6):
+        total = sum(len(o.new_token_ids) for o in results[i])
+        assert total == 5 + i % 3
+
+
+def test_sequential_equals_batched(engine):
+    prompts = [list(range(200 + i * 11, 220 + i * 11)) for i in range(4)]
+    solo = [engine.generate(prompt_ids=p, sampling=greedy(6)).token_ids for p in prompts]
+    engine.flush_cache()
+    results = {}
+    for i, p in enumerate(prompts):
+        engine.submit(p, greedy(6), on_output=lambda o, i=i: results.setdefault(i, []).append(o))
+    for _ in range(200):
+        engine.step()
+        if len([k for k, v in results.items() if v and v[-1].finished]) == 4:
+            break
+    batched = [
+        [t for o in results[i] for t in o.new_token_ids] for i in range(4)
+    ]
+    assert batched == solo
+
+
+def test_kv_events_emitted(engine):
+    batches = []
+    unsub = engine.events.subscribe(batches.append)
+    engine.generate(prompt_ids=list(range(300, 340)), sampling=greedy(4))
+    unsub()
+    stored = [e for b in batches for e in b.events if type(e).__name__ == "BlockStored"]
+    assert stored, "expected BlockStored events after a completed request"
+    assert all(len(e.block_hashes) * e.block_size == len(e.token_ids) for e in stored)
+
+
+def test_abort_waiting_and_running(engine):
+    rid = engine.submit(list(range(5, 25)), greedy(50))
+    assert engine.abort(rid)
+    assert not engine.scheduler.has_work() or engine.scheduler.requests.get(rid) is None
+
+
+def test_max_new_tokens_zero(engine):
+    res = engine.generate(prompt_ids=list(range(5, 15)), sampling=greedy(0))
+    assert res.token_ids == []
+    assert res.finish_reason == "length"
+
+
+def test_page_pressure_preemption():
+    # tiny pool: 2 concurrent long generations must fight for pages
+    eng = make_engine(num_pages=12, max_batch=4, max_seq_len=128, watermark_pages=1)
+    results = {}
+    for i in range(3):
+        eng.submit(
+            list(range(10 + i * 3, 40 + i * 3)),  # 30 tokens → 2 pages each
+            greedy(40),
+            on_output=lambda o, i=i: results.setdefault(i, []).append(o),
+        )
+    for _ in range(500):
+        eng.step()
+        if len([k for k, v in results.items() if v and v[-1].finished]) == 3:
+            break
+    assert all(results[i][-1].finished for i in range(3)), (
+        f"unfinished under page pressure; loads={eng.loads()}, "
+        f"preemptions={eng.scheduler.num_preemptions}"
+    )
+    for i in range(3):
+        total = sum(len(o.new_token_ids) for o in results[i])
+        assert total == 40
+
+
+def test_loads_reporting(engine):
+    loads = engine.loads()
+    assert loads["num_running"] == 0
+    assert loads["free_pages"] > 0
