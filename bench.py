@@ -1,0 +1,107 @@
+"""Benchmark: decode throughput of the in-tree TPU engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures steady-state decode tokens/sec/chip through the full engine
+(continuous-batching scheduler + paged KV + fused sampling) on a
+Llama-3.2-1B-class model (bf16, random weights — tokenizer-free token-id
+workload, which is exactly what the gateway's gRPC path ships to workers;
+SURVEY.md §0 "workers only see token IDs").
+
+Baseline: the reference's CI-gated e2e floor is 12 output tok/s per request
+stream (BASELINE.md, `test_regular_perf.py:27`) with ~32 concurrent requests
+per H100 worker => ~384 tok/s/GPU floor.  vs_baseline = value / 384.
+On non-TPU hosts this still runs (tiny model) but reports the TPU metric name
+with a "cpu-smoke" suffix so results are never confused.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+
+    from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+    from smg_tpu.engine.engine import Engine
+    from smg_tpu.models.config import llama32_1b_config, tiny_test_config
+    from smg_tpu.protocols.sampling import SamplingParams
+
+    if on_tpu:
+        model_cfg = llama32_1b_config()
+        batch, prompt_len, gen_len = 32, 128, 64
+        max_seq = 1024
+        pages = 32 * (max_seq // 16) + 64
+        dtype = "bfloat16"
+    else:
+        model_cfg = tiny_test_config()
+        batch, prompt_len, gen_len = 8, 32, 16
+        max_seq = 128
+        pages = 128
+        dtype = "float32"
+
+    cfg = EngineConfig(
+        model=model_cfg,
+        cache=CacheConfig(page_size=16, num_pages=pages, auto_size=False, dtype=dtype),
+        scheduler=SchedulerConfig(
+            max_batch_size=batch,
+            max_seq_len=max_seq,
+            max_prefill_tokens=512 if on_tpu else 64,
+            prefill_token_buckets=(128, 256, 512) if on_tpu else (32, 64),
+            decode_batch_buckets=(batch,),
+        ),
+        dtype=dtype,
+    )
+    engine = Engine(cfg)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(10, model_cfg.vocab_size - 10, prompt_len).tolist() for _ in range(batch)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len, ignore_eos=True)
+
+    def run_round(tag: str) -> tuple[float, int]:
+        finished = set()
+
+        def cb(out, rid_box=[None]):
+            if out.finished:
+                finished.add(out.rid)
+
+        for i, p in enumerate(prompts):
+            engine.submit(p, sp, rid=f"{tag}-{i}", on_output=cb)
+        # prefill phase (admission happens inside step)
+        t0 = time.perf_counter()
+        decode_tokens = 0
+        start_decode = engine.scheduler.num_decode_tokens
+        while len(finished) < batch:
+            engine.step()
+            if time.perf_counter() - t0 > 600:
+                raise TimeoutError(f"bench stuck: {engine.loads()}")
+        dt = time.perf_counter() - t0
+        decode_tokens = engine.scheduler.num_decode_tokens - start_decode
+        return dt, decode_tokens
+
+    # warmup (compile)
+    run_round("warmup")
+    engine.flush_cache()
+
+    dt, decode_tokens = run_round("bench")
+    total_new = batch * gen_len
+    tput = total_new / dt
+
+    baseline = 384.0  # reference CI floor: 12 tok/s/stream x 32 streams per chip
+    result = {
+        "metric": "decode_tokens_per_sec_per_chip" if on_tpu else "decode_tokens_per_sec_cpu_smoke",
+        "value": round(tput, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tput / baseline, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

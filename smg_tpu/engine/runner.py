@@ -53,14 +53,17 @@ class ModelRunner:
         )
 
         key = jax.random.PRNGKey(config.seed)
+        self.param_shardings = None
+        if self.mesh is not None:
+            self.param_shardings = tree_shardings(
+                self.module.logical_axes(self.model_cfg), self.mesh, self.rules
+            )
         if params is not None:
             self.params = params
         elif self.mesh is not None:
-            shardings = tree_shardings(
-                self.module.logical_axes(self.model_cfg), self.mesh, self.rules
-            )
             self.params = jax.jit(
-                partial(self.module.init_params, self.model_cfg), out_shardings=shardings
+                partial(self.module.init_params, self.model_cfg),
+                out_shardings=self.param_shardings,
             )(key)
         else:
             self.params = jax.jit(partial(self.module.init_params, self.model_cfg))(key)
@@ -126,7 +129,17 @@ class ModelRunner:
             toks, lps = sample_tokens(logits[None], key, temp, topk, topp, minp)
             return toks[0], lps[0], kc, vc
 
-        fn = jax.jit(step, donate_argnums=(5, 6))
+        if self.mesh is not None:
+            r = self._replicated
+            fn = jax.jit(
+                step,
+                in_shardings=(self.param_shardings, r, r, r, r,
+                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
+                donate_argnums=(5, 6),
+            )
+        else:
+            fn = jax.jit(step, donate_argnums=(5, 6))
         self._compiled[k] = fn
         return fn
 
@@ -145,7 +158,17 @@ class ModelRunner:
             toks, lps = sample_tokens(logits, key, temps, topks, topps, minps)
             return toks, lps, kc, vc
 
-        fn = jax.jit(step, donate_argnums=(4, 5))
+        if self.mesh is not None:
+            r = self._replicated
+            fn = jax.jit(
+                step,
+                in_shardings=(self.param_shardings, r, r, r,
+                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
+                donate_argnums=(4, 5),
+            )
+        else:
+            fn = jax.jit(step, donate_argnums=(4, 5))
         self._compiled[k] = fn
         return fn
 

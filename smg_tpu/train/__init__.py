@@ -1,0 +1,3 @@
+from smg_tpu.train.step import TrainState, make_train_step
+
+__all__ = ["TrainState", "make_train_step"]
