@@ -1,0 +1,7 @@
+"""The model-routing gateway: HTTP APIs, worker management, routing policies.
+
+Reference: ``model_gateway/`` (SURVEY.md §1 layers 2-6) rebuilt in async
+Python around the in-tree TPU engine; the wire contract to workers is
+token-level (gateway tokenizes/detokenizes, workers see token ids — SURVEY.md
+§0 "gateway-side text processing").
+"""

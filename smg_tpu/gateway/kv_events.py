@@ -1,0 +1,68 @@
+"""KvEventMonitor: per-worker KV-event subscriptions feeding cache_aware.
+
+Reference: ``model_gateway/src/worker/kv_event_monitor.rs:1-11`` — on worker
+registration, subscribe to its KV-event stream and feed the positional
+indexer; unsubscribe + purge on removal (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+from smg_tpu.gateway.workers import Worker, WorkerRegistry
+from smg_tpu.policies import PolicyRegistry
+from smg_tpu.policies.cache_aware import CacheAwarePolicy
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.kv_events")
+
+
+class KvEventMonitor:
+    def __init__(self, registry: WorkerRegistry, policies: PolicyRegistry):
+        self.registry = registry
+        self.policies = policies
+        self._unsubs: dict[str, callable] = {}
+        registry.on_change(self._on_change)
+
+    def _cache_policy(self, model_id: str) -> CacheAwarePolicy | None:
+        policy = self.policies.policy_for(model_id)
+        return policy if isinstance(policy, CacheAwarePolicy) else None
+
+    def _on_change(self, event: str, worker: Worker) -> None:
+        if event == "added":
+            policy = self._cache_policy(worker.model_id)
+            if policy is None:
+                return
+            # sync the event-tree page size to the worker's engine page size —
+            # mismatched page sizes make every chain hash miss silently
+            if worker.page_size and worker.page_size != policy.indexer.page_size:
+                if policy.indexer.stats()["blocks"] == 0:
+                    policy.indexer.page_size = worker.page_size
+                    logger.info(
+                        "cache_aware indexer page_size set to %d (from %s)",
+                        worker.page_size, worker.worker_id,
+                    )
+                else:
+                    logger.warning(
+                        "worker %s page_size=%d != indexer page_size=%d; "
+                        "event-mode matching will miss for this worker",
+                        worker.worker_id, worker.page_size, policy.indexer.page_size,
+                    )
+
+            def on_batch(batch, wid=worker.worker_id, p=policy):
+                p.apply_kv_events(wid, batch)
+
+            try:
+                self._unsubs[worker.worker_id] = worker.client.subscribe_kv_events(on_batch)
+                logger.info("kv-event subscription started for %s", worker.worker_id)
+            except Exception:
+                logger.exception("kv-event subscribe failed for %s", worker.worker_id)
+        elif event == "removed":
+            unsub = self._unsubs.pop(worker.worker_id, None)
+            if unsub is not None:
+                try:
+                    unsub()
+                except Exception:
+                    pass
+            policy = self._cache_policy(worker.model_id)
+            if policy is not None:
+                policy.on_worker_removed(worker.worker_id)
+            self.policies.on_worker_removed(worker.worker_id)

@@ -1,0 +1,142 @@
+"""Process assembly for the CLI: launch (gateway), serve (engine+gateway),
+worker (bare engine behind gRPC).
+
+Reference: ``server.rs startup()`` orchestration (SURVEY.md §3.1) and the
+Python wrapper's serve flow (``bindings/python/src/smg/serve.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.launch")
+
+
+def _maybe_force_cpu() -> None:
+    """SMG_FORCE_CPU=1 pins jax to the CPU backend even when an accelerator
+    plugin registers itself unconditionally (ignoring JAX_PLATFORMS)."""
+    import os
+
+    if os.environ.get("SMG_FORCE_CPU") == "1":
+        import jax
+
+        try:
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+            logger.info("SMG_FORCE_CPU=1: pinned default device to CPU")
+        except RuntimeError:
+            logger.warning("SMG_FORCE_CPU=1 set but no CPU backend found")
+
+
+def build_engine_from_args(args):
+    _maybe_force_cpu()
+    from smg_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from smg_tpu.engine.engine import Engine
+    from smg_tpu.models.config import PRESETS, ModelConfig
+
+    if args.model_path:
+        model = ModelConfig.from_pretrained(args.model_path)
+    elif args.model_preset:
+        model = PRESETS[args.model_preset]()
+    else:
+        raise SystemExit("need --model-path or --model-preset")
+
+    cfg = EngineConfig(
+        model=model,
+        model_path=args.model_path,
+        tokenizer_path=args.tokenizer_path or args.model_path,
+        parallel=ParallelConfig(dp=args.dp, tp=args.tp),
+        cache=CacheConfig(page_size=args.page_size),
+        scheduler=SchedulerConfig(
+            max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len
+        ),
+        model_id=args.model_path or args.model_preset,
+    )
+    params = None
+    if args.model_path:
+        from smg_tpu.models.weights import load_params
+
+        params = load_params(cfg)
+    return Engine(cfg, params=params)
+
+
+def load_tokenizer(path: str | None):
+    if path is None:
+        from smg_tpu.tokenizer import MockTokenizer
+
+        logger.warning("no tokenizer path; using MockTokenizer")
+        return MockTokenizer()
+    from smg_tpu.tokenizer.hf import HFTokenizer
+
+    return HFTokenizer(path)
+
+
+def run_command(args) -> int:
+    if args.command == "worker":
+        return run_worker(args)
+    return asyncio.run(_run_gateway(args))
+
+
+def run_worker(args) -> int:
+    from smg_tpu.rpc.server import serve_worker
+
+    engine = build_engine_from_args(args)
+    engine.start()
+    return serve_worker(engine, port=args.grpc_port)
+
+
+async def _run_gateway(args) -> int:
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.workers import Worker
+
+    ctx = AppContext(
+        policy=args.policy, max_concurrent_requests=args.max_concurrent_requests
+    )
+
+    if args.command == "serve":
+        from smg_tpu.gateway.worker_client import InProcWorkerClient
+
+        engine = build_engine_from_args(args)
+        tokenizer = load_tokenizer(args.tokenizer_path or args.model_path)
+        ctx.tokenizers.register(engine.config.model_id, tokenizer, default=True)
+        client = InProcWorkerClient(engine)
+        ctx.registry.add(
+            Worker(
+                worker_id="inproc-0", client=client, model_id=engine.config.model_id,
+                page_size=engine.config.cache.page_size,
+            )
+        )
+    for url in getattr(args, "workers", []):
+        from smg_tpu.rpc.client import GrpcWorkerClient
+
+        client = GrpcWorkerClient(url)
+        info = await client.get_model_info()
+        ctx.registry.add(
+            Worker(
+                worker_id=url, client=client, model_id=info.get("model_id", "default"),
+                url=url, page_size=info.get("page_size") or None,
+            )
+        )
+
+    app = build_app(ctx)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port)
+    await site.start()
+    logger.info("gateway listening on %s:%d", args.host, args.port)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await runner.cleanup()
+    return 0

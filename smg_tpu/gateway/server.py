@@ -1,0 +1,397 @@
+"""HTTP server: OpenAI-compatible APIs + admin/ops endpoints.
+
+Reference: ``model_gateway/src/server.rs`` route table (``:778-922``) —
+/v1/chat/completions, /v1/completions, /v1/models, /generate, probes
+(/health, /health_generate, /readiness), ops (/get_loads, /flush_cache,
+/workers CRUD), /metrics (Prometheus).  aiohttp; SSE streaming for chat and
+completions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+
+from aiohttp import web
+
+from smg_tpu.gateway.kv_events import KvEventMonitor
+from smg_tpu.gateway.router import RouteError, Router, RouterConfig
+from smg_tpu.gateway.workers import Worker, WorkerRegistry
+from smg_tpu.policies import PolicyRegistry
+from smg_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ErrorInfo,
+    ErrorResponse,
+    ModelCard,
+    ModelList,
+)
+from smg_tpu.protocols.generate import GenerateMetaInfo, GenerateRequest, GenerateResponse
+from smg_tpu.tokenizer.registry import TokenizerRegistry
+from smg_tpu.utils import get_logger
+from smg_tpu.utils.logging import request_id_var
+from smg_tpu.version import __version__
+
+logger = get_logger("gateway.server")
+
+
+class AppContext:
+    """DI container (reference: ``src/app_context.rs:51``)."""
+
+    def __init__(
+        self,
+        policy: str = "cache_aware",
+        router_config: RouterConfig | None = None,
+        max_concurrent_requests: int = 256,
+    ):
+        self.registry = WorkerRegistry()
+        self.policies = PolicyRegistry(default=policy)
+        self.tokenizers = TokenizerRegistry()
+        self.kv_monitor = KvEventMonitor(self.registry, self.policies)
+        self.router = Router(self.registry, self.policies, self.tokenizers, router_config)
+        self.semaphore = asyncio.Semaphore(max_concurrent_requests)
+        self.metrics = None  # attached by observability setup
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+    body = ErrorResponse(error=ErrorInfo(message=message, type=err_type))
+    return web.json_response(body.model_dump(), status=status)
+
+
+def _sse_response(request: web.Request) -> web.StreamResponse:
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+            "X-Accel-Buffering": "no",
+        },
+    )
+    return resp
+
+
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    rid = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
+    request["request_id"] = rid
+    token = request_id_var.set(rid)
+    try:
+        resp = await handler(request)
+        resp.headers.setdefault("X-Request-Id", rid)
+        return resp
+    finally:
+        request_id_var.reset(token)
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except RouteError as e:
+        return _error(e.status, e.message, e.err_type)
+    except web.HTTPException:
+        raise
+    except Exception as e:
+        logger.exception("unhandled error on %s", request.path)
+        return _error(500, f"internal error: {e}", "internal_error")
+
+
+def build_app(ctx: AppContext) -> web.Application:
+    app = web.Application(middlewares=[request_id_middleware, error_middleware])
+    app["ctx"] = ctx
+
+    app.router.add_get("/health", h_health)
+    app.router.add_get("/liveness", h_health)
+    app.router.add_get("/readiness", h_readiness)
+    app.router.add_get("/health_generate", h_health_generate)
+    app.router.add_get("/v1/models", h_models)
+    app.router.add_get("/get_server_info", h_server_info)
+    app.router.add_post("/v1/chat/completions", h_chat)
+    app.router.add_post("/v1/completions", h_completions)
+    app.router.add_post("/generate", h_generate)
+    app.router.add_post("/v1/tokenize", h_tokenize)
+    app.router.add_post("/v1/detokenize", h_detokenize)
+    app.router.add_get("/get_loads", h_get_loads)
+    app.router.add_post("/flush_cache", h_flush_cache)
+    app.router.add_get("/workers", h_workers_list)
+    app.router.add_post("/workers", h_workers_add)
+    app.router.add_delete("/workers/{worker_id}", h_workers_remove)
+    return app
+
+
+# ---- probes / info ----
+
+async def h_health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok", "version": __version__})
+
+
+async def h_readiness(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    workers = ctx.registry.list()
+    healthy = [w for w in workers if w.is_available()]
+    status = 200 if healthy else 503
+    return web.json_response(
+        {"ready": bool(healthy), "workers": len(workers), "healthy": len(healthy)},
+        status=status,
+    )
+
+
+async def h_health_generate(request: web.Request) -> web.Response:
+    """End-to-end probe: a 1-token generation through the pipeline
+    (reference exposes the same as /health_generate)."""
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.sampling import SamplingParams
+    from smg_tpu.policies import RequestContext
+
+    tok = ctx.tokenizers.get(None)
+    if tok is None:
+        return _error(503, "no tokenizer", "service_unavailable")
+    ids = tok.encode("health probe")[:8] or [1]
+    sampling = SamplingParams(max_new_tokens=1, ignore_eos=True)
+    rid = f"health-{uuid.uuid4().hex[:8]}"
+    rctx = RequestContext(token_ids=ids, request_id=rid)
+    try:
+        async for _ in ctx.router._execute(rctx, ids, sampling, rid, None):
+            pass
+        return web.json_response({"status": "ok"})
+    except RouteError as e:
+        return _error(e.status, e.message, e.err_type)
+
+
+async def h_models(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    ids = ctx.registry.model_ids() or ["default"]
+    return web.json_response(ModelList(data=[ModelCard(id=i) for i in ids]).model_dump())
+
+
+async def h_server_info(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response(
+        {
+            "version": __version__,
+            "workers": [w.describe() for w in ctx.registry.list()],
+        }
+    )
+
+
+# ---- inference APIs ----
+
+async def h_chat(request: web.Request) -> web.Response | web.StreamResponse:
+    ctx: AppContext = request.app["ctx"]
+    try:
+        req = ChatCompletionRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    rid = request["request_id"]
+    async with ctx.semaphore:
+        if not req.stream:
+            resp = await ctx.router.chat(req, request_id=rid)
+            return web.json_response(resp.model_dump(exclude_none=True))
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        try:
+            async for chunk in ctx.router.chat_stream(req, request_id=rid):
+                data = chunk.model_dump(exclude_none=True)
+                await sse.write(f"data: {json.dumps(data)}\n\n".encode())
+            await sse.write(b"data: [DONE]\n\n")
+        except RouteError as e:
+            err = ErrorResponse(error=ErrorInfo(message=e.message, type=e.err_type))
+            await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def h_completions(request: web.Request) -> web.Response | web.StreamResponse:
+    ctx: AppContext = request.app["ctx"]
+    try:
+        req = CompletionRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    rid = request["request_id"]
+    async with ctx.semaphore:
+        if not req.stream:
+            resp = await ctx.router.completion(req, request_id=rid)
+            return web.json_response(resp.model_dump(exclude_none=True))
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        try:
+            async for chunk in ctx.router.completion_stream(req, request_id=rid):
+                data = chunk.model_dump(exclude_none=True)
+                await sse.write(f"data: {json.dumps(data)}\n\n".encode())
+            await sse.write(b"data: [DONE]\n\n")
+        except RouteError as e:
+            err = ErrorResponse(error=ErrorInfo(message=e.message, type=e.err_type))
+            await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def h_generate(request: web.Request) -> web.Response | web.StreamResponse:
+    """SGLang-compatible native generate endpoint."""
+    ctx: AppContext = request.app["ctx"]
+    try:
+        req = GenerateRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    rid = req.rid or request["request_id"]
+    sampling = req.to_sampling_params(ctx.router.config.default_max_tokens)
+
+    if isinstance(req.text, list) or (req.input_ids and isinstance(req.input_ids[0], list)):
+        return _error(400, "batch generate not yet supported; send one prompt per request")
+
+    tokenizer = ctx.tokenizers.get(None)
+    if req.input_ids is not None:
+        input_ids = list(req.input_ids)
+        text = None
+    elif req.text is not None:
+        if tokenizer is None:
+            return _error(500, "no tokenizer registered")
+        text = req.text
+        input_ids = ctx.tokenizers.encode_cached(None, text)
+    else:
+        return _error(400, "need text or input_ids")
+
+    from smg_tpu.policies import RequestContext
+
+    rctx = RequestContext(text=text, token_ids=input_ids, request_id=rid)
+
+    async with ctx.semaphore:
+        if not req.stream:
+            parts: list[str] = []
+            token_ids: list[int] = []
+            last = None
+            async for ev in ctx.router._execute(rctx, input_ids, sampling, rid, tokenizer):
+                parts.append(ev.text_delta)
+                token_ids.extend(ev.token_ids)
+                last = ev
+            resp = GenerateResponse(
+                text="".join(parts),
+                output_ids=token_ids,
+                meta_info=GenerateMetaInfo(
+                    id=rid,
+                    finish_reason={"type": last.finish_reason, "matched": last.matched_stop}
+                    if last and last.finish_reason
+                    else None,
+                    prompt_tokens=last.prompt_tokens if last else 0,
+                    completion_tokens=last.output_tokens if last else 0,
+                    cached_tokens=last.cached_tokens if last else 0,
+                ),
+            )
+            return web.json_response(resp.model_dump())
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        acc_text = []
+        acc_ids: list[int] = []
+        async for ev in ctx.router._execute(rctx, input_ids, sampling, rid, tokenizer):
+            acc_text.append(ev.text_delta)
+            acc_ids.extend(ev.token_ids)
+            payload = GenerateResponse(
+                text="".join(acc_text),
+                output_ids=acc_ids,
+                meta_info=GenerateMetaInfo(
+                    id=rid,
+                    finish_reason={"type": ev.finish_reason, "matched": ev.matched_stop}
+                    if ev.finish_reason
+                    else None,
+                    prompt_tokens=ev.prompt_tokens,
+                    completion_tokens=ev.output_tokens,
+                    cached_tokens=ev.cached_tokens,
+                ),
+            )
+            await sse.write(f"data: {json.dumps(payload.model_dump())}\n\n".encode())
+        await sse.write(b"data: [DONE]\n\n")
+        await sse.write_eof()
+        return sse
+
+
+# ---- tokenize/detokenize ----
+
+async def h_tokenize(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    body = await request.json()
+    tok = ctx.tokenizers.get(body.get("model"))
+    if tok is None:
+        return _error(500, "no tokenizer registered")
+    text = body.get("text") or body.get("prompt") or ""
+    ids = tok.encode(text, add_special_tokens=body.get("add_special_tokens", False))
+    return web.json_response({"tokens": ids, "count": len(ids)})
+
+
+async def h_detokenize(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    body = await request.json()
+    tok = ctx.tokenizers.get(body.get("model"))
+    if tok is None:
+        return _error(500, "no tokenizer registered")
+    ids = body.get("tokens") or []
+    text = tok.decode(ids, skip_special_tokens=body.get("skip_special_tokens", True))
+    return web.json_response({"text": text})
+
+
+# ---- ops ----
+
+async def h_get_loads(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    loads = []
+    for w in ctx.registry.list():
+        entry = {"worker_id": w.worker_id, "gateway_load": w.load}
+        try:
+            entry.update(await w.client.get_loads())
+        except Exception as e:
+            entry["error"] = str(e)
+        loads.append(entry)
+    return web.json_response({"loads": loads})
+
+
+async def h_flush_cache(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    results = {}
+    for w in ctx.registry.list():
+        try:
+            results[w.worker_id] = await w.client.flush_cache()
+        except Exception as e:
+            results[w.worker_id] = f"error: {e}"
+    return web.json_response({"flushed": results})
+
+
+async def h_workers_list(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response({"workers": [w.describe() for w in ctx.registry.list()]})
+
+
+async def h_workers_add(request: web.Request) -> web.Response:
+    """Register a remote worker by URL (gRPC)."""
+    ctx: AppContext = request.app["ctx"]
+    body = await request.json()
+    url = body.get("url")
+    if not url:
+        return _error(400, "missing url")
+    from smg_tpu.rpc.client import GrpcWorkerClient
+
+    client = GrpcWorkerClient(url)
+    try:
+        info = await client.get_model_info()
+    except Exception as e:
+        await client.close()
+        return _error(502, f"worker unreachable: {e}", "worker_error")
+    worker = Worker(
+        worker_id=body.get("worker_id") or url,
+        client=client,
+        model_id=body.get("model_id") or info.get("model_id", "default"),
+        url=url,
+        page_size=info.get("page_size") or None,
+    )
+    ctx.registry.add(worker)
+    return web.json_response({"added": worker.describe()})
+
+
+async def h_workers_remove(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    wid = request.match_info["worker_id"]
+    worker = ctx.registry.remove(wid)
+    if worker is None:
+        return _error(404, f"no such worker {wid}")
+    await worker.client.close()
+    return web.json_response({"removed": wid})

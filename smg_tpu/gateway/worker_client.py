@@ -1,0 +1,134 @@
+"""Worker client abstraction: how the gateway talks to engine workers.
+
+Reference: layer 7, ``crates/grpc_client`` — tonic clients implementing the
+scheduler proto (Generate-stream/Health/Abort/GetLoads/FlushCache/
+SubscribeKvEvents, ``sglang_scheduler.proto:11-61``).  Two transports:
+
+- ``InProcWorkerClient``: the TPU engine lives in the gateway process
+  (single-host serving, ``smg-tpu serve``);
+- ``GrpcWorkerClient`` (``smg_tpu/rpc/client.py``): remote workers over gRPC.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+from smg_tpu.protocols.sampling import SamplingParams
+
+
+@dataclass
+class WorkerGenerateRequest:
+    rid: str
+    input_ids: list[int]
+    sampling: SamplingParams
+    stream: bool = True
+
+
+@dataclass
+class WorkerStreamChunk:
+    """Token-level increment from a worker (no text: the gateway detokenizes)."""
+
+    rid: str
+    token_ids: list[int] = field(default_factory=list)
+    logprobs: list[float] = field(default_factory=list)
+    finished: bool = False
+    finish_reason: str | None = None
+    matched_stop: str | int | None = None
+    prompt_tokens: int = 0
+    cached_tokens: int = 0
+    output_tokens: int = 0
+
+
+class WorkerClient:
+    """Transport-agnostic worker API (async)."""
+
+    async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    async def abort(self, rid: str) -> bool:
+        raise NotImplementedError
+
+    async def health(self) -> bool:
+        raise NotImplementedError
+
+    async def get_loads(self) -> dict:
+        raise NotImplementedError
+
+    async def get_model_info(self) -> dict:
+        raise NotImplementedError
+
+    async def flush_cache(self) -> bool:
+        raise NotImplementedError
+
+    def subscribe_kv_events(self, callback) -> callable:
+        """Register a KV-event batch callback; returns unsubscribe fn."""
+        return lambda: None
+
+    async def close(self) -> None:
+        pass
+
+
+class InProcWorkerClient(WorkerClient):
+    """Engine in the same process.  The engine's background loop runs in its
+    own thread; outputs hop onto the event loop via call_soon_threadsafe."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        engine.start()
+
+    async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_output(out) -> None:  # engine thread
+            chunk = WorkerStreamChunk(
+                rid=req.rid,
+                token_ids=list(out.new_token_ids),
+                logprobs=list(out.logprobs),
+                finished=out.finished,
+                finish_reason=out.finish_reason,
+                matched_stop=out.matched_stop,
+                prompt_tokens=out.prompt_tokens,
+                cached_tokens=out.cached_tokens,
+                output_tokens=out.output_tokens,
+            )
+            loop.call_soon_threadsafe(q.put_nowait, chunk)
+
+        self.engine.submit(
+            req.input_ids, req.sampling, rid=req.rid, on_output=on_output
+        )
+        while True:
+            chunk = await q.get()
+            yield chunk
+            if chunk.finished:
+                return
+
+    async def abort(self, rid: str) -> bool:
+        return self.engine.abort(rid)
+
+    async def health(self) -> bool:
+        return True
+
+    async def get_loads(self) -> dict:
+        return self.engine.loads()
+
+    async def get_model_info(self) -> dict:
+        cfg = self.engine.config
+        return {
+            "model_id": cfg.model_id,
+            "max_seq_len": cfg.scheduler.max_seq_len,
+            "vocab_size": cfg.model.vocab_size,
+            "eos_token_ids": list(cfg.model.eos_token_ids),
+        }
+
+    async def flush_cache(self) -> bool:
+        return self.engine.flush_cache()
+
+    def subscribe_kv_events(self, callback):
+        return self.engine.events.subscribe(callback)
+
+    async def close(self) -> None:
+        self.engine.stop()

@@ -1,0 +1,230 @@
+"""Worker abstraction, registry, circuit breaker, load guards.
+
+Reference: ``model_gateway/src/worker/`` (SURVEY.md §2.1): ``trait Worker``
+(url/type/status/load/circuit-breaker, ``worker.rs:193-390``),
+``WorkerRegistry`` with events (``registry.rs:89``), three-state
+``CircuitBreaker`` (``circuit_breaker.rs:41,103``), RAII ``WorkerLoadGuard``.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable
+
+from smg_tpu.gateway.worker_client import WorkerClient
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.workers")
+
+
+class CircuitState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Three-state breaker: CLOSED -> (N consecutive failures) -> OPEN ->
+    (cooldown) -> HALF_OPEN -> (M consecutive successes) -> CLOSED."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        success_threshold: int = 2,
+        cooldown_secs: float = 30.0,
+    ):
+        self.failure_threshold = failure_threshold
+        self.success_threshold = success_threshold
+        self.cooldown_secs = cooldown_secs
+        self._state = CircuitState.CLOSED
+        self._consecutive_failures = 0
+        self._consecutive_successes = 0
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            if (
+                self._state == CircuitState.OPEN
+                and time.monotonic() - self._opened_at >= self.cooldown_secs
+            ):
+                self._state = CircuitState.HALF_OPEN
+                self._consecutive_successes = 0
+            return self._state
+
+    def allow(self) -> bool:
+        return self.state != CircuitState.OPEN
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == CircuitState.HALF_OPEN:
+                self._consecutive_successes += 1
+                if self._consecutive_successes >= self.success_threshold:
+                    self._state = CircuitState.CLOSED
+            elif self._state == CircuitState.OPEN:
+                pass
+            else:
+                self._consecutive_successes += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_successes = 0
+            self._consecutive_failures += 1
+            if self._state == CircuitState.HALF_OPEN or (
+                self._state == CircuitState.CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._state = CircuitState.OPEN
+                self._opened_at = time.monotonic()
+
+
+class WorkerType(enum.Enum):
+    REGULAR = "regular"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    ENCODE = "encode"
+
+
+class Worker:
+    """A registered worker: client + gateway-side state."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        client: WorkerClient,
+        model_id: str = "default",
+        worker_type: WorkerType = WorkerType.REGULAR,
+        url: str = "",
+        priority: int = 0,
+        page_size: int | None = None,
+    ):
+        self.worker_id = worker_id
+        self.client = client
+        self.model_id = model_id
+        self.worker_type = worker_type
+        self.url = url or worker_id
+        self.priority = priority
+        self.page_size = page_size  # engine KV page size (cache_aware event mode)
+        self.circuit = CircuitBreaker()
+        self.healthy = True
+        self._load = 0
+        self._lock = threading.Lock()
+        self.registered_at = time.time()
+        self.total_requests = 0
+        self.total_failures = 0
+
+    @property
+    def load(self) -> int:
+        return self._load
+
+    def is_available(self) -> bool:
+        return self.healthy and self.circuit.allow()
+
+    def acquire(self) -> "WorkerLoadGuard":
+        return WorkerLoadGuard(self)
+
+    def _inc(self) -> None:
+        with self._lock:
+            self._load += 1
+            self.total_requests += 1
+
+    def _dec(self) -> None:
+        with self._lock:
+            self._load = max(0, self._load - 1)
+
+    def describe(self) -> dict:
+        return {
+            "worker_id": self.worker_id,
+            "model_id": self.model_id,
+            "type": self.worker_type.value,
+            "url": self.url,
+            "healthy": self.healthy,
+            "circuit": self.circuit.state.value,
+            "load": self.load,
+            "total_requests": self.total_requests,
+            "total_failures": self.total_failures,
+        }
+
+
+class WorkerLoadGuard:
+    """RAII load accounting (reference: ``load_guard_raii_test.rs``).
+    Releases exactly once, on success or failure."""
+
+    def __init__(self, worker: Worker):
+        self.worker = worker
+        self._released = False
+        worker._inc()
+
+    def release(self, success: bool = True) -> None:
+        if self._released:
+            return
+        self._released = True
+        self.worker._dec()
+        if success:
+            self.worker.circuit.record_success()
+        else:
+            self.worker.circuit.record_failure()
+            self.worker.total_failures += 1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release(success=exc_type is None)
+
+
+class WorkerRegistry:
+    """Thread-safe worker registry with add/remove listeners
+    (reference: ``worker/registry.rs:89``, 2,674 LoC)."""
+
+    def __init__(self):
+        self._workers: dict[str, Worker] = {}
+        self._lock = threading.Lock()
+        self._listeners: list[Callable[[str, Worker], None]] = []
+
+    def add(self, worker: Worker) -> None:
+        with self._lock:
+            if worker.worker_id in self._workers:
+                raise ValueError(f"worker {worker.worker_id} already registered")
+            self._workers[worker.worker_id] = worker
+        logger.info("worker registered: %s (model=%s)", worker.worker_id, worker.model_id)
+        self._notify("added", worker)
+
+    def remove(self, worker_id: str) -> Worker | None:
+        with self._lock:
+            worker = self._workers.pop(worker_id, None)
+        if worker is not None:
+            logger.info("worker removed: %s", worker_id)
+            self._notify("removed", worker)
+        return worker
+
+    def get(self, worker_id: str) -> Worker | None:
+        with self._lock:
+            return self._workers.get(worker_id)
+
+    def list(self, model_id: str | None = None, worker_type: WorkerType | None = None) -> list[Worker]:
+        with self._lock:
+            ws = list(self._workers.values())
+        if model_id is not None:
+            ws = [w for w in ws if w.model_id == model_id]
+        if worker_type is not None:
+            ws = [w for w in ws if w.worker_type == worker_type]
+        return ws
+
+    def model_ids(self) -> list[str]:
+        with self._lock:
+            return sorted({w.model_id for w in self._workers.values()})
+
+    def on_change(self, listener: Callable[[str, Worker], None]) -> None:
+        self._listeners.append(listener)
+
+    def _notify(self, event: str, worker: Worker) -> None:
+        for cb in self._listeners:
+            try:
+                cb(event, worker)
+            except Exception:
+                logger.exception("worker registry listener failed")

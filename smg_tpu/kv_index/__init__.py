@@ -1,0 +1,11 @@
+"""Cache-state index structures for cache-aware routing.
+
+Reference: ``crates/kv_index`` (SURVEY.md §2.2) — ``TokenTree``/``StringTree``
+approximate radix trees with LRU eviction, and the event-driven
+``PositionalIndexer`` fed by worker KV events.
+"""
+
+from smg_tpu.kv_index.radix_tree import RadixTree
+from smg_tpu.kv_index.positional import PositionalIndexer
+
+__all__ = ["RadixTree", "PositionalIndexer"]

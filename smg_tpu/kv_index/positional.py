@@ -1,0 +1,92 @@
+"""Event-driven positional KV index.
+
+Reference: ``crates/kv_index/src/event_tree.rs:1-21`` — a map keyed by
+``(position, content_hash)`` holding per-worker presence, fed by worker
+``BlockStored``/``BlockRemoved`` events; queries jump-search the deepest
+position at which a worker still holds the request's prefix.
+
+The engine's block hashes form a rolling chain (parent hash + page tokens →
+hash, ``smg_tpu/engine/radix_cache.py``), so the gateway recomputes the same
+chain over a request's tokens and probes which workers hold each depth.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import defaultdict
+
+from smg_tpu.protocols.events import AllBlocksCleared, BlockRemoved, BlockStored, KvEventBatch
+
+
+def chain_hash(parent_hash: int, tokens: tuple[int, ...]) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_hash.to_bytes(8, "little", signed=False))
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little", signed=False))
+    return int.from_bytes(h.digest(), "little")
+
+
+class PositionalIndexer:
+    def __init__(self, page_size: int = 16):
+        self.page_size = page_size
+        # block_hash -> set of worker ids holding it
+        self._blocks: dict[int, set[str]] = defaultdict(set)
+        # worker -> set of block hashes (for removal / worker eviction)
+        self._worker_blocks: dict[str, set[int]] = defaultdict(set)
+
+    def apply_batch(self, worker_id: str, batch: KvEventBatch) -> None:
+        for ev in batch.events:
+            if isinstance(ev, BlockStored):
+                for h in ev.block_hashes:
+                    self._blocks[h].add(worker_id)
+                    self._worker_blocks[worker_id].add(h)
+            elif isinstance(ev, BlockRemoved):
+                for h in ev.block_hashes:
+                    s = self._blocks.get(h)
+                    if s is not None:
+                        s.discard(worker_id)
+                        if not s:
+                            self._blocks.pop(h, None)
+                    self._worker_blocks[worker_id].discard(h)
+            elif isinstance(ev, AllBlocksCleared):
+                self.remove_worker(worker_id)
+
+    def remove_worker(self, worker_id: str) -> None:
+        for h in self._worker_blocks.pop(worker_id, set()):
+            s = self._blocks.get(h)
+            if s is not None:
+                s.discard(worker_id)
+                if not s:
+                    self._blocks.pop(h, None)
+
+    def match(self, token_ids: list[int]) -> dict[str, int]:
+        """Per-worker matched prefix length (in tokens) for this request."""
+        ps = self.page_size
+        n_pages = len(token_ids) // ps
+        if n_pages == 0 or not self._blocks:
+            return {}
+        # rolling hash chain over full pages
+        hashes: list[int] = []
+        parent = 0
+        for i in range(n_pages):
+            parent = chain_hash(parent, tuple(token_ids[i * ps : (i + 1) * ps]))
+            hashes.append(parent)
+        out: dict[str, int] = {}
+        # galloping from depth 0; most requests match shallowly or not at all
+        alive: set[str] | None = None
+        for depth, h in enumerate(hashes):
+            holders = self._blocks.get(h)
+            if not holders:
+                break
+            alive = holders if alive is None else (alive & holders)
+            if not alive:
+                break
+            for w in alive:
+                out[w] = (depth + 1) * ps
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "blocks": len(self._blocks),
+            "workers": len(self._worker_blocks),
+        }

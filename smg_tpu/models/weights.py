@@ -1,0 +1,120 @@
+"""HF safetensors -> smg_tpu param pytree loading, with sharded placement.
+
+Reference analogue: weight loading lives in the external engines; in-tree
+here.  Reads ``*.safetensors`` lazily tensor-by-tensor and places each on its
+target sharding to avoid host-memory spikes.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("models.weights")
+
+
+def _hf_key_map(cfg, n_layers: int) -> dict[str, tuple[str, ...]]:
+    """our param tree path -> HF tensor name template."""
+    m = {
+        ("embed",): "model.embed_tokens.weight",
+        ("final_norm",): "model.norm.weight",
+        ("layers", "attn_norm"): "model.layers.{i}.input_layernorm.weight",
+        ("layers", "wq"): "model.layers.{i}.self_attn.q_proj.weight",
+        ("layers", "wk"): "model.layers.{i}.self_attn.k_proj.weight",
+        ("layers", "wv"): "model.layers.{i}.self_attn.v_proj.weight",
+        ("layers", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
+        ("layers", "mlp_norm"): "model.layers.{i}.post_attention_layernorm.weight",
+        ("layers", "w_gate"): "model.layers.{i}.mlp.gate_proj.weight",
+        ("layers", "w_up"): "model.layers.{i}.mlp.up_proj.weight",
+        ("layers", "w_down"): "model.layers.{i}.mlp.down_proj.weight",
+    }
+    if not cfg.tie_word_embeddings:
+        m[("lm_head",)] = "lm_head.weight"
+    return m
+
+
+def _transform(path: tuple[str, ...], w: np.ndarray, cfg) -> np.ndarray:
+    """HF [out, in] linear layout -> our einsum layouts."""
+    E, H, K, D, F = (
+        cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size,
+    )
+    leaf = path[-1]
+    if leaf == "wq":
+        return w.reshape(H, D, E).transpose(2, 0, 1)  # [E, H, D]
+    if leaf in ("wk", "wv"):
+        return w.reshape(K, D, E).transpose(2, 0, 1)  # [E, K, D]
+    if leaf == "wo":
+        return w.reshape(E, H, D).transpose(1, 2, 0)  # [H, D, E]
+    if leaf in ("w_gate", "w_up"):
+        return w.transpose(1, 0)  # [E, F]
+    if leaf == "w_down":
+        return w.transpose(1, 0)  # [F, E]
+    if leaf == "lm_head":
+        return w.transpose(1, 0)  # [E, V]
+    return w  # embed [V, E], norms [E]
+
+
+def load_params(engine_cfg, mesh=None, rules=None):
+    """Load params for ``engine_cfg.model`` from ``engine_cfg.model_path``."""
+    from safetensors import safe_open
+
+    cfg = engine_cfg.model
+    path = engine_cfg.model_path
+    dtype = jnp.dtype(engine_cfg.dtype)
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {path}")
+
+    # tensor name -> file handle index
+    location: dict[str, int] = {}
+    handles = [safe_open(f, framework="numpy") for f in files]
+    for i, h in enumerate(handles):
+        for name in h.keys():
+            location[name] = i
+
+    shardings = None
+    if mesh is not None:
+        from smg_tpu.models.registry import get_model
+        from smg_tpu.parallel.sharding import tree_shardings, ShardingRules
+
+        module = get_model(cfg.arch)
+        shardings = tree_shardings(module.logical_axes(cfg), mesh, rules or ShardingRules())
+
+    def fetch(name: str) -> np.ndarray:
+        if name not in location:
+            raise KeyError(f"tensor {name} not found in checkpoint")
+        return handles[location[name]].get_tensor(name)
+
+    key_map = _hf_key_map(cfg, cfg.num_layers)
+    params: dict = {"layers": {}}
+    for path_key, tmpl in key_map.items():
+        if "{i}" in tmpl:
+            stack = [
+                _transform(path_key, fetch(tmpl.format(i=i)), cfg)
+                for i in range(cfg.num_layers)
+            ]
+            arr = np.stack(stack)
+        else:
+            arr = _transform(path_key, fetch(tmpl), cfg)
+        target = params
+        for k in path_key[:-1]:
+            target = target[k]
+        sh = None
+        if shardings is not None:
+            node = shardings
+            for k in path_key:
+                node = node[k]
+            sh = node
+        jarr = jnp.asarray(arr, dtype=dtype)
+        if sh is not None:
+            jarr = jax.device_put(jarr, sh)
+        target[path_key[-1]] = jarr
+        logger.info("loaded %s %s", "/".join(path_key), jarr.shape)
+    return params

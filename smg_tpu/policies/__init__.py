@@ -1,0 +1,11 @@
+"""Load-balancing policies (reference: ``model_gateway/src/policies/``,
+SURVEY.md §2.1: 10 policies + registry behind ``trait LoadBalancingPolicy``).
+"""
+
+from smg_tpu.policies.base import Policy, PolicyRegistry, RequestContext, get_policy
+# import modules for registration side effects
+from smg_tpu.policies import simple as _simple  # noqa: F401
+from smg_tpu.policies import hashing as _hashing  # noqa: F401
+from smg_tpu.policies import cache_aware as _cache_aware  # noqa: F401
+
+__all__ = ["Policy", "PolicyRegistry", "RequestContext", "get_policy"]

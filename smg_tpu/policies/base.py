@@ -1,0 +1,91 @@
+"""Policy trait + registry.
+
+Reference: ``trait LoadBalancingPolicy::select_worker``
+(``model_gateway/src/policies/mod.rs:47-56``) and ``PolicyRegistry``
+(``policies/registry.rs:29``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol, Sequence
+
+
+class WorkerLike(Protocol):
+    worker_id: str
+    model_id: str
+
+    @property
+    def load(self) -> int: ...
+
+    def is_available(self) -> bool: ...
+
+
+@dataclass
+class RequestContext:
+    """What a policy may look at when selecting a worker
+    (reference: ``SelectWorkerInfo``, ``policies/mod.rs:214``)."""
+
+    text: str | None = None
+    token_ids: list[int] | None = None
+    model_id: str | None = None
+    routing_key: str | None = None  # sticky routing (manual policy)
+    request_id: str | None = None
+    headers: dict = field(default_factory=dict)
+
+
+class Policy:
+    name: str = "base"
+
+    def select_worker(
+        self, workers: Sequence[WorkerLike], ctx: RequestContext
+    ) -> WorkerLike | None:
+        raise NotImplementedError
+
+    # feedback hooks
+    def on_request_complete(self, worker_id: str, success: bool) -> None:
+        pass
+
+    def on_worker_removed(self, worker_id: str) -> None:
+        pass
+
+    @staticmethod
+    def available(workers: Sequence[WorkerLike]) -> list[WorkerLike]:
+        return [w for w in workers if w.is_available()]
+
+
+_POLICIES: dict[str, type[Policy]] = {}
+
+
+def register_policy(cls: type[Policy]) -> type[Policy]:
+    _POLICIES[cls.name] = cls
+    return cls
+
+
+def get_policy(name: str, **kwargs) -> Policy:
+    if name not in _POLICIES:
+        raise KeyError(f"unknown policy {name!r}; known: {sorted(_POLICIES)}")
+    return _POLICIES[name](**kwargs)
+
+
+class PolicyRegistry:
+    """Per-model policy instances with a default fallback
+    (multi-model 'IGW' mode routes each model by its own policy)."""
+
+    def __init__(self, default: str = "cache_aware", **default_kwargs):
+        self._default_name = default
+        self._default_kwargs = default_kwargs
+        self._per_model: dict[str, Policy] = {}
+
+    def policy_for(self, model_id: str | None) -> Policy:
+        key = model_id or "__default__"
+        if key not in self._per_model:
+            self._per_model[key] = get_policy(self._default_name, **self._default_kwargs)
+        return self._per_model[key]
+
+    def set_policy(self, model_id: str, name: str, **kwargs) -> None:
+        self._per_model[model_id] = get_policy(name, **kwargs)
+
+    def on_worker_removed(self, worker_id: str) -> None:
+        for p in self._per_model.values():
+            p.on_worker_removed(worker_id)

@@ -1,0 +1,185 @@
+"""Gateway-side gRPC worker client.
+
+Reference: ``crates/grpc_client`` tonic clients (channel reuse, abort-on-drop,
+KV-event subscription).  grpc.aio with hand-wired method stubs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+import grpc
+import grpc.aio
+
+from smg_tpu.gateway.worker_client import (
+    WorkerClient,
+    WorkerGenerateRequest,
+    WorkerStreamChunk,
+)
+from smg_tpu.rpc import method
+from smg_tpu.rpc import scheduler_pb2 as pb
+from smg_tpu.rpc.convert import kv_batch_from_proto, sampling_to_proto
+from smg_tpu.utils import get_logger
+
+logger = get_logger("rpc.client")
+
+
+class GrpcWorkerClient(WorkerClient):
+    def __init__(self, url: str):
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        self.url = url
+        self._channel = grpc.aio.insecure_channel(
+            url,
+            options=[
+                ("grpc.max_send_message_length", 64 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                ("grpc.keepalive_time_ms", 30000),
+            ],
+        )
+        c = self._channel
+        self._generate = c.unary_stream(
+            method("Generate"),
+            request_serializer=pb.GenerateRequestProto.SerializeToString,
+            response_deserializer=pb.GenerateChunk.FromString,
+        )
+        self._abort = c.unary_unary(
+            method("Abort"),
+            request_serializer=pb.AbortRequestProto.SerializeToString,
+            response_deserializer=pb.AbortResponseProto.FromString,
+        )
+        self._health = c.unary_unary(
+            method("HealthCheck"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.HealthResponseProto.FromString,
+        )
+        self._get_loads = c.unary_unary(
+            method("GetLoads"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.LoadsProto.FromString,
+        )
+        self._model_info = c.unary_unary(
+            method("GetModelInfo"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.ModelInfoProto.FromString,
+        )
+        self._flush = c.unary_unary(
+            method("FlushCache"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.FlushResponseProto.FromString,
+        )
+        self._kv_events = c.unary_stream(
+            method("SubscribeKvEvents"),
+            request_serializer=pb.KvEventsRequestProto.SerializeToString,
+            response_deserializer=pb.KvEventBatchProto.FromString,
+        )
+        self._kv_tasks: list[asyncio.Task] = []
+
+    async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
+        msg = pb.GenerateRequestProto(
+            rid=req.rid, input_ids=req.input_ids, sampling=sampling_to_proto(req.sampling)
+        )
+        call = self._generate(msg)
+        try:
+            async for chunk in call:
+                if chunk.error:
+                    raise RuntimeError(f"worker error: {chunk.error}")
+                yield WorkerStreamChunk(
+                    rid=chunk.rid,
+                    token_ids=list(chunk.token_ids),
+                    logprobs=list(chunk.logprobs),
+                    finished=chunk.finished,
+                    finish_reason=chunk.finish_reason or None,
+                    matched_stop=(
+                        chunk.matched_stop_token if chunk.matched_stop_token >= 0 else None
+                    ),
+                    prompt_tokens=chunk.prompt_tokens,
+                    cached_tokens=chunk.cached_tokens,
+                    output_tokens=chunk.output_tokens,
+                )
+        finally:
+            call.cancel()
+
+    async def abort(self, rid: str) -> bool:
+        try:
+            resp = await self._abort(pb.AbortRequestProto(rid=rid), timeout=5)
+            return resp.ok
+        except grpc.aio.AioRpcError:
+            return False
+
+    async def health(self) -> bool:
+        try:
+            resp = await self._health(pb.EmptyProto(), timeout=5)
+            return resp.ok
+        except grpc.aio.AioRpcError:
+            return False
+
+    async def get_loads(self) -> dict:
+        resp = await self._get_loads(pb.EmptyProto(), timeout=5)
+        return {
+            "num_waiting": resp.num_waiting,
+            "num_running": resp.num_running,
+            "free_pages": resp.free_pages,
+            "cached_pages": resp.cached_pages,
+            "total_pages": resp.total_pages,
+        }
+
+    async def get_model_info(self) -> dict:
+        resp = await self._model_info(pb.EmptyProto(), timeout=10)
+        return {
+            "model_id": resp.model_id,
+            "max_seq_len": resp.max_seq_len,
+            "vocab_size": resp.vocab_size,
+            "eos_token_ids": list(resp.eos_token_ids),
+            "page_size": resp.page_size,
+        }
+
+    async def flush_cache(self) -> bool:
+        resp = await self._flush(pb.EmptyProto(), timeout=30)
+        return resp.ok
+
+    def subscribe_kv_events(self, callback):
+        """Spawn a background task streaming KV events into ``callback``."""
+        stop = asyncio.Event()
+
+        async def pump():
+            seq = 0
+            while not stop.is_set():
+                try:
+                    call = self._kv_events(pb.KvEventsRequestProto(start_sequence_number=seq))
+                    async for batch in call:
+                        if stop.is_set():
+                            call.cancel()
+                            break
+                        seq = batch.sequence_number
+                        callback(kv_batch_from_proto(batch))
+                except grpc.aio.AioRpcError as e:
+                    if stop.is_set():
+                        break
+                    logger.warning("kv-event stream to %s dropped (%s); resuming at %d",
+                                   self.url, e.code(), seq)
+                if not stop.is_set():
+                    # backoff also covers clean stream ends (re-dial loop)
+                    await asyncio.sleep(1.0)
+
+        try:
+            loop = asyncio.get_running_loop()
+            task = loop.create_task(pump())
+            self._kv_tasks.append(task)
+        except RuntimeError:
+            # no running loop (sync context): subscription starts when the
+            # gateway loop runs; caller should re-subscribe from async code
+            logger.warning("subscribe_kv_events called outside event loop; ignored")
+            return lambda: None
+
+        def unsubscribe():
+            stop.set()
+            task.cancel()
+
+        return unsubscribe
+
+    async def close(self) -> None:
+        for t in self._kv_tasks:
+            t.cancel()
+        await self._channel.close()

@@ -1,0 +1,98 @@
+"""Dataclass <-> proto conversions with explicit defaults on every field
+(proto3 zero-value pitfall — see scheduler.proto header)."""
+
+from __future__ import annotations
+
+from smg_tpu.protocols.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    KvEventBatch,
+)
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.rpc import scheduler_pb2 as pb
+
+
+def sampling_to_proto(sp: SamplingParams) -> pb.SamplingParamsProto:
+    msg = pb.SamplingParamsProto(
+        max_new_tokens=sp.max_new_tokens,
+        temperature=sp.temperature,
+        top_p=sp.top_p,
+        top_k=sp.top_k,
+        min_p=sp.min_p,
+        frequency_penalty=sp.frequency_penalty,
+        presence_penalty=sp.presence_penalty,
+        repetition_penalty=sp.repetition_penalty,
+        stop_token_ids=sp.stop_token_ids,
+        ignore_eos=sp.ignore_eos,
+        n=sp.n,
+        logprobs=sp.logprobs,
+        top_logprobs=sp.top_logprobs,
+    )
+    if sp.seed is not None:
+        msg.seed = sp.seed
+    return msg
+
+
+def sampling_from_proto(msg: pb.SamplingParamsProto) -> SamplingParams:
+    return SamplingParams(
+        max_new_tokens=msg.max_new_tokens,
+        temperature=msg.temperature,
+        top_p=msg.top_p,
+        top_k=msg.top_k,
+        min_p=msg.min_p,
+        frequency_penalty=msg.frequency_penalty,
+        presence_penalty=msg.presence_penalty,
+        repetition_penalty=msg.repetition_penalty,
+        stop_token_ids=list(msg.stop_token_ids),
+        ignore_eos=msg.ignore_eos,
+        seed=msg.seed if msg.HasField("seed") else None,
+        n=msg.n or 1,
+        logprobs=msg.logprobs,
+        top_logprobs=msg.top_logprobs,
+    )
+
+
+def kv_batch_to_proto(batch: KvEventBatch) -> pb.KvEventBatchProto:
+    msg = pb.KvEventBatchProto(
+        sequence_number=batch.sequence_number, dp_rank=batch.dp_rank
+    )
+    for ev in batch.events:
+        evp = msg.events.add()
+        if isinstance(ev, BlockStored):
+            evp.stored.block_hashes.extend(ev.block_hashes)
+            evp.stored.token_ids.extend(ev.token_ids)
+            evp.stored.block_size = ev.block_size
+            if ev.parent_block_hash is not None:
+                evp.stored.parent_block_hash = ev.parent_block_hash
+        elif isinstance(ev, BlockRemoved):
+            evp.removed.block_hashes.extend(ev.block_hashes)
+        elif isinstance(ev, AllBlocksCleared):
+            evp.all_cleared = True
+    return msg
+
+
+def kv_batch_from_proto(msg: pb.KvEventBatchProto) -> KvEventBatch:
+    events = []
+    for evp in msg.events:
+        which = evp.WhichOneof("event")
+        if which == "stored":
+            events.append(
+                BlockStored(
+                    block_hashes=list(evp.stored.block_hashes),
+                    token_ids=list(evp.stored.token_ids),
+                    parent_block_hash=(
+                        evp.stored.parent_block_hash
+                        if evp.stored.HasField("parent_block_hash")
+                        else None
+                    ),
+                    block_size=evp.stored.block_size,
+                )
+            )
+        elif which == "removed":
+            events.append(BlockRemoved(block_hashes=list(evp.removed.block_hashes)))
+        elif which == "all_cleared":
+            events.append(AllBlocksCleared())
+    return KvEventBatch(
+        sequence_number=msg.sequence_number, events=events, dp_rank=msg.dp_rank
+    )

@@ -1,0 +1,182 @@
+"""Worker-side gRPC servicer: exposes an Engine over the scheduler protocol.
+
+Reference: ``grpc_servicer/smg_grpc_servicer/sglang/servicer.py:191`` — but
+where the reference bridges gRPC -> ZMQ -> external scheduler process
+(SURVEY.md §3.3), ours calls the in-process engine directly; the engine's
+background thread hops results onto the asyncio loop.
+
+Hand-wired generic handlers (no grpc_tools codegen in the toolchain): each
+method is registered via ``grpc.method_handlers_generic_handler`` over the
+protoc-generated messages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+import grpc.aio
+
+from smg_tpu.rpc import SERVICE
+from smg_tpu.rpc import scheduler_pb2 as pb
+from smg_tpu.rpc.convert import kv_batch_to_proto, sampling_from_proto
+from smg_tpu.utils import get_logger
+
+logger = get_logger("rpc.server")
+
+
+class SchedulerServicer:
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def Generate(self, request: pb.GenerateRequestProto, context):
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        sampling = sampling_from_proto(request.sampling)
+
+        def on_output(out) -> None:  # engine thread
+            loop.call_soon_threadsafe(q.put_nowait, out)
+
+        rid = request.rid
+        self.engine.submit(
+            list(request.input_ids), sampling, rid=rid,
+            on_output=on_output, priority=request.priority,
+        )
+        try:
+            while True:
+                out = await q.get()
+                chunk = pb.GenerateChunk(
+                    rid=rid,
+                    token_ids=out.new_token_ids,
+                    logprobs=out.logprobs,
+                    finished=out.finished,
+                    finish_reason=out.finish_reason or "",
+                    matched_stop_token=(
+                        out.matched_stop if isinstance(out.matched_stop, int) else -1
+                    ),
+                    prompt_tokens=out.prompt_tokens,
+                    cached_tokens=out.cached_tokens,
+                    output_tokens=out.output_tokens,
+                )
+                yield chunk
+                if out.finished:
+                    return
+        finally:
+            # client went away mid-stream: stop generating
+            self.engine.abort(rid)
+
+    async def Abort(self, request: pb.AbortRequestProto, context):
+        return pb.AbortResponseProto(ok=self.engine.abort(request.rid))
+
+    async def HealthCheck(self, request: pb.EmptyProto, context):
+        return pb.HealthResponseProto(ok=True)
+
+    async def GetLoads(self, request: pb.EmptyProto, context):
+        loads = self.engine.loads()
+        return pb.LoadsProto(
+            num_waiting=loads["num_waiting"],
+            num_running=loads["num_running"],
+            free_pages=loads["free_pages"],
+            cached_pages=loads["cached_pages"],
+            total_pages=loads["total_pages"],
+        )
+
+    async def GetModelInfo(self, request: pb.EmptyProto, context):
+        cfg = self.engine.config
+        return pb.ModelInfoProto(
+            model_id=cfg.model_id,
+            max_seq_len=cfg.scheduler.max_seq_len,
+            vocab_size=cfg.model.vocab_size,
+            eos_token_ids=list(cfg.model.eos_token_ids),
+            page_size=cfg.cache.page_size,
+        )
+
+    async def FlushCache(self, request: pb.EmptyProto, context):
+        return pb.FlushResponseProto(ok=self.engine.flush_cache())
+
+    async def SubscribeKvEvents(self, request: pb.KvEventsRequestProto, context):
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_batch(batch) -> None:  # engine thread
+            loop.call_soon_threadsafe(q.put_nowait, batch)
+
+        unsub = self.engine.events.subscribe(
+            on_batch, start_sequence_number=request.start_sequence_number
+        )
+        try:
+            while True:
+                batch = await q.get()
+                yield kv_batch_to_proto(batch)
+        finally:
+            unsub()
+
+
+def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
+    rpcs = {
+        "Generate": grpc.unary_stream_rpc_method_handler(
+            servicer.Generate,
+            request_deserializer=pb.GenerateRequestProto.FromString,
+            response_serializer=pb.GenerateChunk.SerializeToString,
+        ),
+        "Abort": grpc.unary_unary_rpc_method_handler(
+            servicer.Abort,
+            request_deserializer=pb.AbortRequestProto.FromString,
+            response_serializer=pb.AbortResponseProto.SerializeToString,
+        ),
+        "HealthCheck": grpc.unary_unary_rpc_method_handler(
+            servicer.HealthCheck,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.HealthResponseProto.SerializeToString,
+        ),
+        "GetLoads": grpc.unary_unary_rpc_method_handler(
+            servicer.GetLoads,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.LoadsProto.SerializeToString,
+        ),
+        "GetModelInfo": grpc.unary_unary_rpc_method_handler(
+            servicer.GetModelInfo,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.ModelInfoProto.SerializeToString,
+        ),
+        "FlushCache": grpc.unary_unary_rpc_method_handler(
+            servicer.FlushCache,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.FlushResponseProto.SerializeToString,
+        ),
+        "SubscribeKvEvents": grpc.unary_stream_rpc_method_handler(
+            servicer.SubscribeKvEvents,
+            request_deserializer=pb.KvEventsRequestProto.FromString,
+            response_serializer=pb.KvEventBatchProto.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE, rpcs)
+
+
+async def serve_worker_async(engine, port: int, host: str = "0.0.0.0") -> grpc.aio.Server:
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ]
+    )
+    server.add_generic_rpc_handlers((_handlers(SchedulerServicer(engine)),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    logger.info("worker gRPC listening on %s:%d", host, bound)
+    server._bound_port = bound  # for tests with port=0
+    return server
+
+
+def serve_worker(engine, port: int, host: str = "0.0.0.0") -> int:
+    async def _main():
+        server = await serve_worker_async(engine, port, host)
+        await server.wait_for_termination()
+
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.stop()
+    return 0

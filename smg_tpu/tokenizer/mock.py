@@ -41,7 +41,9 @@ class MockTokenizer:
             words.append(f"w{int(t)}")
         return " ".join(words)
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True, **_ignored
+    ) -> str:
         parts = [f"[{m['role']}] {m.get('content') or ''}" for m in messages]
         if add_generation_prompt:
             parts.append("[assistant]")

@@ -1,0 +1,285 @@
+"""Gateway e2e over HTTP: tiny in-proc engine + MockTokenizer behind the full
+aiohttp app (reference: tier-2 gateway integration tests against mock
+workers, SURVEY.md §4)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient
+from smg_tpu.gateway.workers import Worker
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def make_engine() -> Engine:
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=256, auto_size=False, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=8, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4, 8),
+        ),
+        dtype="float32",
+        model_id="tiny-test",
+    )
+    return Engine(cfg)  # no tokenizer: worker sees tokens only (gateway detokenizes)
+
+
+@pytest.fixture(scope="module")
+def gateway():
+    """(client, ctx) running on a private event loop thread."""
+    loop = asyncio.new_event_loop()
+
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+    engine = make_engine()
+
+    async def _setup():
+        client = InProcWorkerClient(engine)
+        ctx.registry.add(Worker(worker_id="w0", client=client, model_id="tiny-test"))
+        server = TestServer(build_app(ctx))
+        tc = TestClient(server)
+        await tc.start_server()
+        return tc
+
+    import threading
+
+    loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
+    loop_thread.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=120)
+
+    tc = run(_setup())
+
+    class Handle:
+        pass
+
+    h = Handle()
+    h.run = run
+    h.client = tc
+    h.ctx = ctx
+    yield h
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+def test_health(gateway):
+    async def go():
+        resp = await gateway.client.get("/health")
+        return resp.status, await resp.json()
+
+    status, body = gateway.run(go())
+    assert status == 200 and body["status"] == "ok"
+
+
+def test_models(gateway):
+    async def go():
+        resp = await gateway.client.get("/v1/models")
+        return await resp.json()
+
+    body = gateway.run(go())
+    assert body["data"][0]["id"] == "tiny-test"
+
+
+def test_chat_completion(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "w5 w6 w7"}],
+                "max_tokens": 8,
+                "temperature": 0,
+                "ignore_eos": True,
+            },
+        )
+        return resp.status, await resp.json()
+
+    status, body = gateway.run(go())
+    assert status == 200, body
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["role"] == "assistant"
+    assert body["choices"][0]["message"]["content"].startswith("w")
+    assert body["choices"][0]["finish_reason"] == "length"
+    assert body["usage"]["completion_tokens"] == 8
+    assert body["usage"]["prompt_tokens"] > 0
+
+
+def test_chat_completion_stream(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "w9 w10"}],
+                "max_tokens": 6,
+                "temperature": 0,
+                "ignore_eos": True,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        raw = await resp.text()
+        return raw
+
+    raw = gateway.run(go())
+    frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    text = "".join(
+        c["choices"][0]["delta"].get("content") or "" for c in chunks if c["choices"]
+    )
+    assert text.startswith("w")
+    finals = [c for c in chunks if c["choices"] and c["choices"][0].get("finish_reason")]
+    assert finals and finals[-1]["choices"][0]["finish_reason"] == "length"
+    usage_chunks = [c for c in chunks if c.get("usage")]
+    assert usage_chunks and usage_chunks[-1]["usage"]["completion_tokens"] == 6
+
+
+def test_chat_n_choices(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "w11"}],
+                "max_tokens": 4,
+                "temperature": 0,
+                "ignore_eos": True,
+                "n": 2,
+            },
+        )
+        return await resp.json()
+
+    body = gateway.run(go())
+    assert len(body["choices"]) == 2
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    # greedy: both choices identical
+    assert body["choices"][0]["message"]["content"] == body["choices"][1]["message"]["content"]
+
+
+def test_completions_endpoint(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/completions",
+            json={"model": "tiny-test", "prompt": "w1 w2 w3", "max_tokens": 5,
+                  "temperature": 0, "ignore_eos": True},
+        )
+        return await resp.json()
+
+    body = gateway.run(go())
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"].startswith("w")
+    assert body["usage"]["completion_tokens"] == 5
+
+
+def test_generate_endpoint(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/generate",
+            json={"text": "w1 w2 w3 w4",
+                  "sampling_params": {"max_new_tokens": 4, "temperature": 0, "ignore_eos": True}},
+        )
+        return await resp.json()
+
+    body = gateway.run(go())
+    assert len(body["output_ids"]) == 4
+    assert body["meta_info"]["completion_tokens"] == 4
+    assert body["meta_info"]["finish_reason"]["type"] == "length"
+
+
+def test_generate_stream(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/generate",
+            json={"text": "w2 w3", "stream": True,
+                  "sampling_params": {"max_new_tokens": 3, "temperature": 0, "ignore_eos": True}},
+        )
+        return await resp.text()
+
+    raw = gateway.run(go())
+    frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    last = json.loads(frames[-2])
+    assert len(last["output_ids"]) == 3
+
+
+def test_tokenize_detokenize(gateway):
+    async def go():
+        r1 = await gateway.client.post("/v1/tokenize", json={"text": "w7 w8 w9"})
+        t = await r1.json()
+        r2 = await gateway.client.post("/v1/detokenize", json={"tokens": t["tokens"]})
+        return t, await r2.json()
+
+    t, d = gateway.run(go())
+    assert t["count"] == 3
+    assert d["text"] == "w7 w8 w9"
+
+
+def test_stop_string_via_gateway(gateway):
+    async def go():
+        probe = await gateway.client.post(
+            "/v1/completions",
+            json={"model": "tiny-test", "prompt": "w20 w21", "max_tokens": 6,
+                  "temperature": 0, "ignore_eos": True},
+        )
+        text = (await probe.json())["choices"][0]["text"]
+        stop_word = text.split()[2]
+        resp = await gateway.client.post(
+            "/v1/completions",
+            json={"model": "tiny-test", "prompt": "w20 w21", "max_tokens": 12,
+                  "temperature": 0, "ignore_eos": True, "stop": stop_word},
+        )
+        return stop_word, await resp.json()
+
+    stop_word, body = gateway.run(go())
+    assert body["choices"][0]["finish_reason"] == "stop"
+    assert stop_word not in body["choices"][0]["text"]
+
+
+def test_invalid_body_400(gateway):
+    async def go():
+        resp = await gateway.client.post("/v1/chat/completions", json={"messages": "nope"})
+        return resp.status
+
+    assert gateway.run(go()) == 400
+
+
+def test_get_loads_and_workers(gateway):
+    async def go():
+        r1 = await gateway.client.get("/get_loads")
+        r2 = await gateway.client.get("/workers")
+        return await r1.json(), await r2.json()
+
+    loads, ws = gateway.run(go())
+    assert loads["loads"][0]["total_pages"] > 0
+    assert ws["workers"][0]["worker_id"] == "w0"
+    assert ws["workers"][0]["healthy"] is True
+
+
+def test_flush_cache(gateway):
+    async def go():
+        resp = await gateway.client.post("/flush_cache")
+        return await resp.json()
+
+    body = gateway.run(go())
+    assert body["flushed"]["w0"] is True
+
+
+def test_health_generate(gateway):
+    async def go():
+        resp = await gateway.client.get("/health_generate")
+        return resp.status
+
+    assert gateway.run(go()) == 200

@@ -1,0 +1,162 @@
+"""Policy unit tests (reference: per-policy tests in model_gateway/src/policies/)."""
+
+from dataclasses import dataclass, field
+
+import pytest
+
+from smg_tpu.policies import RequestContext, get_policy
+from smg_tpu.protocols.events import BlockStored, KvEventBatch
+
+
+@dataclass
+class FakeWorker:
+    worker_id: str
+    model_id: str = "m"
+    load: int = 0
+    healthy: bool = True
+
+    def is_available(self) -> bool:
+        return self.healthy
+
+
+def workers(n=4, **kw):
+    return [FakeWorker(worker_id=f"w{i}", **kw) for i in range(n)]
+
+
+def ctx(**kw):
+    return RequestContext(**kw)
+
+
+def test_round_robin_cycles():
+    p = get_policy("round_robin")
+    ws = workers(3)
+    picks = [p.select_worker(ws, ctx()).worker_id for _ in range(6)]
+    assert picks == ["w0", "w1", "w2", "w0", "w1", "w2"]
+
+
+def test_round_robin_skips_unhealthy():
+    p = get_policy("round_robin")
+    ws = workers(3)
+    ws[1].healthy = False
+    picks = {p.select_worker(ws, ctx()).worker_id for _ in range(4)}
+    assert "w1" not in picks
+
+
+def test_no_workers_returns_none():
+    for name in ("round_robin", "random", "least_load", "power_of_two", "cache_aware"):
+        assert get_policy(name).select_worker([], ctx()) is None
+
+
+def test_least_load():
+    p = get_policy("least_load", seed=0)
+    ws = workers(3)
+    ws[0].load = 5
+    ws[1].load = 1
+    ws[2].load = 3
+    assert p.select_worker(ws, ctx()).worker_id == "w1"
+
+
+def test_power_of_two_prefers_lower_load():
+    p = get_policy("power_of_two", seed=0)
+    ws = workers(2)
+    ws[0].load = 10
+    picks = [p.select_worker(ws, ctx()).worker_id for _ in range(10)]
+    assert all(x == "w1" for x in picks)
+
+
+def test_manual_sticky():
+    p = get_policy("manual", seed=0)
+    ws = workers(4)
+    a = p.select_worker(ws, ctx(routing_key="user-1")).worker_id
+    for _ in range(5):
+        assert p.select_worker(ws, ctx(routing_key="user-1")).worker_id == a
+    p.on_worker_removed(a)
+    ws = [w for w in ws if w.worker_id != a]
+    b = p.select_worker(ws, ctx(routing_key="user-1")).worker_id
+    assert b != a
+
+
+def test_consistent_hashing_stable_and_minimal_disruption():
+    p = get_policy("consistent_hashing")
+    ws = workers(4)
+    keys = [f"key-{i}" for i in range(50)]
+    before = {k: p.select_worker(ws, ctx(routing_key=k)).worker_id for k in keys}
+    after_same = {k: p.select_worker(ws, ctx(routing_key=k)).worker_id for k in keys}
+    assert before == after_same
+    ws2 = ws[:3]  # w3 removed
+    after = {k: p.select_worker(ws2, ctx(routing_key=k)).worker_id for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k] and before[k] != "w3")
+    assert moved == 0  # only keys on the removed worker move
+
+
+def test_prefix_hash_same_prefix_same_worker():
+    p = get_policy("prefix_hash", prefix_tokens=4)
+    ws = workers(4)
+    a = p.select_worker(ws, ctx(token_ids=[1, 2, 3, 4, 99]))
+    b = p.select_worker(ws, ctx(token_ids=[1, 2, 3, 4, 42, 77]))
+    assert a.worker_id == b.worker_id
+
+
+def test_bucket_separates_length_bands():
+    p = get_policy("bucket", boundaries=(10,))
+    ws = workers(4)
+    short = p.select_worker(ws, ctx(token_ids=list(range(5))))
+    long = p.select_worker(ws, ctx(token_ids=list(range(50))))
+    assert short.worker_id != long.worker_id
+
+
+def test_cache_aware_approx_affinity():
+    p = get_policy("cache_aware", mode="approx_token", match_threshold=0.3, seed=0)
+    ws = workers(4)
+    prefix = list(range(100))
+    first = p.select_worker(ws, ctx(token_ids=prefix))
+    # same long prefix + small suffix: must stick to the same worker
+    for i in range(5):
+        again = p.select_worker(ws, ctx(token_ids=prefix + [200 + i]))
+        assert again.worker_id == first.worker_id
+
+
+def test_cache_aware_imbalance_falls_back_to_shortest_queue():
+    p = get_policy("cache_aware", mode="approx_token", imbalance_abs=4, imbalance_rel=1.2, seed=0)
+    ws = workers(2)
+    prefix = list(range(64))
+    first = p.select_worker(ws, ctx(token_ids=prefix))
+    first.load = 50  # heavy imbalance toward the cached worker
+    other = [w for w in ws if w is not first][0]
+    pick = p.select_worker(ws, ctx(token_ids=prefix))
+    assert pick.worker_id == other.worker_id
+
+
+def test_cache_aware_event_mode():
+    p = get_policy("cache_aware", mode="event", match_threshold=0.4, page_size=4, seed=0)
+    ws = workers(3)
+    tokens = list(range(16))
+    # simulate w2 holding the first 3 pages of this prompt
+    from smg_tpu.kv_index.positional import chain_hash
+
+    hashes, parent = [], 0
+    for i in range(3):
+        parent = chain_hash(parent, tuple(tokens[i * 4 : (i + 1) * 4]))
+        hashes.append(parent)
+    p.apply_kv_events(
+        "w2",
+        KvEventBatch(
+            sequence_number=1,
+            events=[BlockStored(block_hashes=hashes, token_ids=tokens[:12], block_size=4)],
+        ),
+    )
+    assert p.select_worker(ws, ctx(token_ids=tokens)).worker_id == "w2"
+
+
+def test_radix_tree_prefix_match():
+    from smg_tpu.kv_index import RadixTree
+
+    t = RadixTree()
+    t.insert("hello world", "w0")
+    t.insert("hello there", "w1")
+    m = t.prefix_match("hello world!")
+    assert m["w0"] == len("hello world")
+    assert m["w1"] == len("hello ")
+    t.remove_worker("w0")
+    m2 = t.prefix_match("hello world!")
+    assert "w0" not in m2
