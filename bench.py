@@ -39,12 +39,14 @@ def main() -> None:
         max_seq = 1024
         pages = 32 * (max_seq // 16) + 64
         dtype = "bfloat16"
+        horizon = 16
     else:
         model_cfg = tiny_test_config()
         batch, prompt_len, gen_len = 8, 32, 16
         max_seq = 128
         pages = 128
         dtype = "float32"
+        horizon = 4
 
     cfg = EngineConfig(
         model=model_cfg,
@@ -55,6 +57,7 @@ def main() -> None:
             max_prefill_tokens=512 if on_tpu else 64,
             prefill_token_buckets=(128, 256, 512) if on_tpu else (32, 64),
             decode_batch_buckets=(batch,),
+            decode_horizon=horizon,
         ),
         dtype=dtype,
     )

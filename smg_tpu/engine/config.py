@@ -77,6 +77,14 @@ class SchedulerConfig:
     schedule_policy: str = "fcfs"  # fcfs | priority
     enable_prefix_cache: bool = True
     watermark_pages: int = 8  # keep this many pages free before admitting prefill
+    # decode steps fused per device call (lax.scan); sampled tokens feed back
+    # on-device and the host syncs once per horizon.  >1 trades stop-condition
+    # granularity (up to N-1 discarded overshoot tokens) for dispatch
+    # amortization — the right trade on TPU where host round trips are slow.
+    decode_horizon: int = 1
+    # single-chunk prompts admitted together in one batched prefill call
+    # (fills the MXU and amortizes dispatch; long prompts still chunk solo)
+    max_prefill_group: int = 8
 
     def __post_init__(self) -> None:
         if self.max_batch_size > max(self.decode_batch_buckets):
@@ -107,6 +115,8 @@ class EngineConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     dtype: str = "bfloat16"
     seed: int = 0
+    # attention kernel: "auto" picks pallas on TPU devices, XLA elsewhere
+    attention_impl: str = "auto"
     # serving identity
     model_id: str = "smg-tpu-model"
     # profiling hook (reference: /start_profile proxying, common.proto:75-87)

@@ -144,22 +144,48 @@ class Engine:
         )
         if req.detok is None:
             return out
-        text = req.detok.put(so.new_token_ids) if so.new_token_ids else ""
-        if so.finished:
-            text += req.detok.flush()
         if req.stop_checker is not None:
-            emitted, stopped = req.stop_checker.feed(text)
-            if stopped and not so.finished:
-                # found a stop string: finish now, trim held-back text
+            # feed token-by-token so a mid-chunk stop (decode horizon) trims
+            # both the text AND the trailing tokens after the stop
+            emitted_parts: list[str] = []
+            consumed = 0
+            stopped = False
+            for tok in so.new_token_ids:
+                piece, stopped = req.stop_checker.feed(req.detok.put([tok]))
+                consumed += 1
+                emitted_parts.append(piece)
+                if stopped:
+                    break
+            if stopped and consumed < len(so.new_token_ids):
+                # roll back the overshoot tokens (their KV past seq_len never
+                # enters the radix cache)
+                cut = len(so.new_token_ids) - consumed
+                out.new_token_ids = out.new_token_ids[:consumed]
+                out.logprobs = out.logprobs[:consumed]
+                req.output_ids = req.output_ids[: len(req.output_ids) - cut]
+                req.logprobs = req.logprobs[: len(req.logprobs) - cut]
+                req.seq_len -= cut
+                out.output_tokens = len(req.output_ids)
+            if stopped:
                 matched = req.stop_checker.matched
-                self.scheduler.finish_request(req.rid, "stop", matched_stop=matched)
+                if not so.finished:
+                    self.scheduler.finish_request(req.rid, "stop", matched_stop=matched)
                 out.finished = True
                 out.finish_reason = "stop"
                 out.matched_stop = matched
             elif so.finished:
-                emitted += req.stop_checker.flush()
-            out.text_delta = emitted
+                piece, stopped_late = req.stop_checker.feed(req.detok.flush())
+                emitted_parts.append(piece)
+                if stopped_late:
+                    out.finish_reason = "stop"
+                    out.matched_stop = req.stop_checker.matched
+                else:
+                    emitted_parts.append(req.stop_checker.flush())
+            out.text_delta = "".join(emitted_parts)
         else:
+            text = req.detok.put(so.new_token_ids) if so.new_token_ids else ""
+            if so.finished:
+                text += req.detok.flush()
             out.text_delta = text
         return out
 

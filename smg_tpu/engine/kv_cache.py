@@ -64,7 +64,11 @@ class KvCacheSpec:
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.num_layers, self.num_pages, self.page_size, self.num_kv_heads, self.head_dim)
+        # fused lane layout (see smg_tpu/ops/attention.py)
+        return (
+            self.num_layers, self.num_pages, self.page_size,
+            self.num_kv_heads * self.head_dim,
+        )
 
     @property
     def bytes_per_page(self) -> int:

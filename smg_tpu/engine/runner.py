@@ -21,7 +21,8 @@ import numpy as np
 
 from smg_tpu.engine.config import EngineConfig
 from smg_tpu.engine.kv_cache import KvCacheSpec, create_kv_buffers, plan_cache
-from smg_tpu.engine.sampling import sample_tokens
+from smg_tpu.engine.sampling import sample_tokens as _sample_fast
+from smg_tpu.engine.sampling import sample_tokens_exact as _sample_exact
 from smg_tpu.models.registry import get_model
 from smg_tpu.ops.rope import rope_frequencies
 from smg_tpu.parallel.mesh import build_mesh
@@ -29,6 +30,13 @@ from smg_tpu.parallel.sharding import ShardingRules, logical_to_sharding, tree_s
 from smg_tpu.utils import get_logger
 
 logger = get_logger("engine.runner")
+
+
+def _pick_sampler():
+    """SMG_EXACT_SAMPLING=1 selects the full-sort exact sampler (no top-k cap)."""
+    import os
+
+    return _sample_exact if os.environ.get("SMG_EXACT_SAMPLING") == "1" else _sample_fast
 
 
 class ModelRunner:
@@ -95,9 +103,38 @@ class ModelRunner:
         self.max_pages_per_seq = math.ceil(
             config.scheduler.max_seq_len / config.cache.page_size
         )
+        self.attn_impl = self._resolve_attn_impl()
+        logger.info("attention impl: %s", self.attn_impl)
         self._rng_key = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self._step = 0
         self._compiled: dict = {}
+
+    def _resolve_attn_impl(self) -> str:
+        import os
+
+        cfgd = self.config.attention_impl
+        if cfgd != "auto":
+            return cfgd
+        if os.environ.get("SMG_DISABLE_PALLAS") == "1":
+            return "xla"
+        kd = self.model_cfg.num_kv_heads * self.model_cfg.head_dim
+        if kd % 128 != 0:
+            return "xla"
+        # dispatch on where the cache actually lives, not the default backend
+        # (some installs register an always-on TPU plugin)
+        try:
+            dev = next(iter(self.k_cache.devices()))
+            if dev.platform != "tpu":
+                return "xla"
+        except Exception:
+            return "xla"
+        # short contexts: XLA's fused gather+softmax wins (the fused-lane
+        # layout makes the gather relayout-free); long contexts: the gather
+        # materializes B*max_seq_len*KD bytes per layer and the page-streaming
+        # pallas kernel wins.  Crossover measured at ~100k gathered tokens
+        # (1B model, v5e).
+        gathered_tokens = self.config.scheduler.max_batch_size * self.config.scheduler.max_seq_len
+        return "pallas" if gathered_tokens > 131072 else "xla"
 
     def _detect_hbm(self) -> int | None:
         try:
@@ -126,7 +163,7 @@ class ModelRunner:
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table
             )
-            toks, lps = sample_tokens(logits[None], key, temp, topk, topp, minp)
+            toks, lps = _pick_sampler()(logits[None], key, temp, topk, topp, minp)
             return toks[0], lps[0], kc, vc
 
         if self.mesh is not None:
@@ -143,6 +180,186 @@ class ModelRunner:
         self._compiled[k] = fn
         return fn
 
+    def _prefill_batched_fn(self, G: int, T: int, mp: int):
+        k = ("prefill_batched", G, T, mp)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+
+        def step(params, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
+                 key, temps, topks, topps, minps):
+            logits, kc, vc = module.forward_prefill_batched(
+                params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables
+            )
+            toks, lps = _pick_sampler()(logits, key, temps, topks, topps, minps)
+            return toks, lps, kc, vc
+
+        if self.mesh is not None:
+            r = self._replicated
+            fn = jax.jit(
+                step,
+                in_shardings=(self.param_shardings, r, r, r, r,
+                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
+                donate_argnums=(5, 6),
+            )
+        else:
+            fn = jax.jit(step, donate_argnums=(5, 6))
+        self._compiled[k] = fn
+        return fn
+
+    def prefill_batched(
+        self,
+        chunks: "list[tuple[list[int], int, np.ndarray]]",  # (token_ids, prefix_len, page_table_row)
+        temps: np.ndarray,  # [G_real]
+        topks: np.ndarray,
+        topps: np.ndarray,
+        minps: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Prefill several single-chunk sequences in one call.
+        Returns (tokens [G_real], logprobs [G_real])."""
+        g_real = len(chunks)
+        G = 1
+        while G < g_real:
+            G *= 2
+        t_max = max(len(c[0]) for c in chunks)
+        T = self.config.scheduler.prefill_bucket(t_max)
+        mp = len(chunks[0][2])
+        tokens = np.zeros((G, T), np.int32)
+        prefix_lens = np.zeros(G, np.int32)
+        t_reals = np.zeros(G, np.int32)
+        page_tables = np.zeros((G, mp), np.int32)
+        ftemps = np.zeros(G, np.float32)
+        ftopks = np.full(G, -1, np.int32)
+        ftopps = np.ones(G, np.float32)
+        fminps = np.zeros(G, np.float32)
+        for i, (ids, pfx, row) in enumerate(chunks):
+            tokens[i, : len(ids)] = ids
+            prefix_lens[i] = pfx
+            t_reals[i] = len(ids)
+            page_tables[i] = row
+            ftemps[i] = temps[i]
+            ftopks[i] = topks[i]
+            ftopps[i] = topps[i]
+            fminps[i] = minps[i]
+        fn = self._prefill_batched_fn(G, T, mp)
+        toks, lps, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.inv_freq,
+            jnp.asarray(tokens),
+            jnp.asarray(prefix_lens),
+            jnp.asarray(t_reals),
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(page_tables),
+            self._next_key(),
+            jnp.asarray(ftemps),
+            jnp.asarray(ftopks),
+            jnp.asarray(ftopps),
+            jnp.asarray(fminps),
+        )
+        return np.asarray(toks)[:g_real], np.asarray(lps)[:g_real]
+
+    def _decode_multi_fn(self, B: int, mp: int, N: int):
+        """N decode steps fused into one jitted lax.scan: sampled tokens feed
+        back on-device, so host round trips amortize N-fold (the decisive win
+        when dispatch latency rivals step compute).  Overshoot past a
+        finished/stopped sequence writes to the garbage page and is trimmed
+        host-side."""
+        k = ("decode_multi", B, mp, N)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+        ps = self.spec.page_size
+        KD = cfg.num_kv_heads * cfg.head_dim
+        L = cfg.num_layers
+        attn_impl = self.attn_impl
+
+        def multi(params, inv_freq, tokens, entry_pos, kc, vc, page_tables,
+                  key, temps, topks, topps, minps):
+            keys = jax.random.split(key, N)
+            cache_dtype = kc.dtype
+            hk = jnp.zeros((L, B, N, KD), cache_dtype)
+            hv = jnp.zeros((L, B, N, KD), cache_dtype)
+
+            def body(carry, xs):
+                toks, hk, hv = carry
+                j, kj = xs
+                logits, hk, hv = module.forward_decode_horizon(
+                    params, cfg, inv_freq, toks, entry_pos + j, entry_pos, j,
+                    kc, vc, page_tables, hk, hv, attn_impl=attn_impl,
+                )
+                new, lps = _pick_sampler()(logits, kj, temps, topks, topps, minps)
+                return (new, hk, hv), (new, lps)
+
+            (_, hk, hv), (outs, lps) = jax.lax.scan(
+                body, (tokens, hk, hv), (jnp.arange(N), keys)
+            )
+
+            # land the whole horizon into the donated cache in one scatter
+            total = mp * ps
+            pos = entry_pos[:, None] + jnp.arange(N)[None, :]  # [B, N]
+            valid = pos < total
+            pos_c = jnp.minimum(pos, total - 1)
+            page = jnp.take_along_axis(page_tables, pos_c // ps, axis=1)
+            dest = jnp.where(valid, page * ps + pos_c % ps, 0).reshape(-1)  # [B*N]
+            kvals = hk.reshape(L, B * N, KD)
+            vvals = hv.reshape(L, B * N, KD)
+            P = kc.shape[1]
+            kc = kc.reshape(L, P * ps, KD).at[:, dest].set(
+                kvals.astype(kc.dtype)
+            ).reshape(kc.shape)
+            vc = vc.reshape(L, P * ps, KD).at[:, dest].set(
+                vvals.astype(vc.dtype)
+            ).reshape(vc.shape)
+            return outs.T, lps.T, kc, vc  # [B, N]
+
+        if self.mesh is not None:
+            r = self._replicated
+            fn = jax.jit(
+                multi,
+                in_shardings=(self.param_shardings, r, r, r,
+                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
+                donate_argnums=(4, 5),
+            )
+        else:
+            fn = jax.jit(multi, donate_argnums=(4, 5))
+        self._compiled[k] = fn
+        return fn
+
+    def decode_multi(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        page_tables: np.ndarray,  # [B, mp]
+        temps: np.ndarray,
+        topks: np.ndarray,
+        topps: np.ndarray,
+        minps: np.ndarray,
+        num_steps: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
+        B, mp = page_tables.shape
+        fn = self._decode_multi_fn(B, mp, num_steps)
+        toks, lps, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.inv_freq,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(page_tables, jnp.int32),
+            self._next_key(),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topks, jnp.int32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(minps, jnp.float32),
+        )
+        return np.asarray(toks), np.asarray(lps)
+
     def _decode_fn(self, B: int, mp: int):
         k = ("decode", B, mp)
         if k in self._compiled:
@@ -155,7 +372,7 @@ class ModelRunner:
             logits, kc, vc = module.forward_decode(
                 params, cfg, inv_freq, tokens, positions, kc, vc, page_tables
             )
-            toks, lps = sample_tokens(logits, key, temps, topks, topps, minps)
+            toks, lps = _pick_sampler()(logits, key, temps, topks, topps, minps)
             return toks, lps, kc, vc
 
         if self.mesh is not None:

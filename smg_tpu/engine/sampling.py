@@ -3,8 +3,15 @@
 One fused function over the whole decode batch with per-slot parameter arrays
 (continuous batching mixes requests with different sampling configs in one
 step).  Wire-parity with the reference's ``SamplingParams``
-(``sglang_scheduler.proto:67-101``); implementation is TPU-first: fixed
-shapes, no data-dependent control flow, gumbel-argmax sampling.
+(``sglang_scheduler.proto:67-101``).
+
+TPU-first implementation: **no full-vocab sort**.  Filtering works by
+computing per-row probability thresholds from ``lax.top_k`` over the top
+``K_CAP`` candidates, then sampling with gumbel-argmax over the masked
+logits.  top-k is exact for ``top_k <= K_CAP``; top-p is exact whenever the
+nucleus fits in ``K_CAP`` candidates and conservatively includes the whole
+distribution otherwise (wider, never narrower, than requested).  A full-sort
+exact reference (``sample_tokens_exact``) backs the property tests.
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+K_CAP = 64  # top-k candidates examined for thresholds
 
 
 def sample_tokens(
@@ -28,15 +36,83 @@ def sample_tokens(
     B, V = logits.shape
     greedy = temperature <= 0.0
     safe_temp = jnp.where(greedy, 1.0, temperature)
+    z = (logits / safe_temp[:, None]).astype(jnp.float32)
+
+    # top-K_CAP candidates give us every threshold we need
+    k_cap = min(K_CAP, V)
+    top_vals, _ = jax.lax.top_k(z, k_cap)  # [B, k_cap] descending
+
+    # top-k threshold: value of the k-th largest (clamped to k_cap)
+    k_eff = jnp.where(top_k <= 0, k_cap, jnp.minimum(top_k, k_cap)).astype(jnp.int32)
+    kth = jnp.take_along_axis(top_vals, (k_eff - 1)[:, None], axis=1)[:, 0]
+    thresh_k = jnp.where(top_k <= 0, -jnp.inf, kth)  # disabled => no filter
+
+    # top-p applies to the distribution *after* top-k renormalization
+    # (sequential-filter semantics, matching the exact reference).  With
+    # top-k on (k <= K_CAP) the candidates cover the entire filtered set, so
+    # renormalization over them is exact; with top-k off, normalize over the
+    # full row.
+    cand_idx = jax.lax.broadcasted_iota(jnp.int32, (B, k_cap), 1)
+    in_topk = cand_idx < k_eff[:, None]
+    masked_vals = jnp.where(in_topk | (top_k[:, None] <= 0), top_vals, -jnp.inf)
+    lse_full = jax.nn.logsumexp(z, axis=-1, keepdims=True)  # [B, 1]
+    lse_topk = jax.nn.logsumexp(masked_vals, axis=-1, keepdims=True)
+    denom = jnp.where((top_k > 0)[:, None], lse_topk, lse_full)
+    cand_probs = jnp.exp(masked_vals - denom)  # [B, K_CAP] descending
+    cum_excl = jnp.cumsum(cand_probs, axis=-1) - cand_probs
+    in_nucleus = (cum_excl < top_p[:, None]) & (cand_probs > 0)  # keeps top-1
+    # smallest kept candidate's logit = threshold; if the nucleus spills past
+    # K_CAP (only possible with top-k off), conservatively keep everything
+    spills = (cum_excl[:, -1] + cand_probs[:, -1] < top_p) & (top_k <= 0)
+    kept_vals = jnp.where(in_nucleus, top_vals, jnp.inf)
+    thresh_p = jnp.min(kept_vals, axis=-1)
+    thresh_p = jnp.where(spills | (top_p >= 1.0), -jnp.inf, thresh_p)
+
+    # min-p threshold: min_p * max_prob, in logit space
+    max_logit = top_vals[:, 0]
+    thresh_m = jnp.where(
+        min_p > 0.0,
+        max_logit + jnp.log(jnp.maximum(min_p, 1e-10)),
+        -jnp.inf,
+    )
+
+    thresh = jnp.maximum(jnp.maximum(thresh_k, thresh_p), thresh_m)
+    zf = jnp.where(z >= thresh[:, None], z, NEG_INF)
+
+    g = jax.random.gumbel(key, z.shape, jnp.float32)
+    sampled = jnp.argmax(zf + g, axis=-1)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    tokens = jnp.where(greedy, greedy_tok, sampled).astype(jnp.int32)
+
+    # chosen-token logprob under the unfiltered distribution (no sort):
+    # logprob = logit/T? No — OpenAI semantics: log softmax of raw logits.
+    raw_lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    chosen_logit = jnp.take_along_axis(
+        logits.astype(jnp.float32), tokens[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    return tokens, chosen_logit - raw_lse
+
+
+def sample_tokens_exact(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    min_p: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-sort reference implementation (exact for any top_k/top_p).
+    Used by tests and available via SMG_EXACT_SAMPLING=1."""
+    B, V = logits.shape
+    greedy = temperature <= 0.0
+    safe_temp = jnp.where(greedy, 1.0, temperature)
     z = logits / safe_temp[:, None]
 
-    # top-k via ranks (full argsort: exact; TODO pallas/top-k fast path)
-    order = jnp.argsort(-z, axis=-1)  # [B, V] token ids, desc
-    ranks = jnp.argsort(order, axis=-1)  # rank of each token id
+    order = jnp.argsort(-z, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
     k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)
     z = jnp.where(ranks < k_eff[:, None], z, NEG_INF)
 
-    # top-p (nucleus) on the filtered dist; exclusive cumsum keeps top-1 always
     probs = jax.nn.softmax(z, axis=-1)
     sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
     cum_excl = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
@@ -44,7 +120,6 @@ def sample_tokens(
     keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
     z = jnp.where(keep, z, NEG_INF)
 
-    # min-p: drop tokens below min_p * max_prob
     probs = jax.nn.softmax(z, axis=-1)
     max_prob = probs.max(axis=-1, keepdims=True)
     z = jnp.where(probs >= min_p[:, None] * max_prob, z, NEG_INF)
@@ -54,7 +129,7 @@ def sample_tokens(
     greedy_tok = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy, greedy_tok, sampled).astype(jnp.int32)
 
-    all_logprobs = jax.nn.log_softmax(logits, axis=-1)
+    all_logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     chosen = jnp.take_along_axis(all_logprobs, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
     return tokens, chosen
 

@@ -117,76 +117,123 @@ class Scheduler:
 
     def _admit(self, outputs: list[StepOutput]) -> None:
         while self.waiting:
-            free_slots = [i for i, s in enumerate(self.slots) if s is None]
-            if not free_slots:
+            # collect a group of admissible single-chunk prompts; long prompts
+            # run solo through the chunk loop
+            group: list[EngineRequest] = []
+            admitted_any = False
+            while self.waiting and len(group) < self.sched.max_prefill_group:
+                free_slots = [i for i, s in enumerate(self.slots) if s is None]
+                if not free_slots:
+                    break
+                req = self.waiting[0]
+                prompt = req.all_token_ids  # includes prior output after preemption
+                if len(prompt) + 1 > self.sched.max_seq_len:
+                    self.waiting.popleft()
+                    req.status = RequestStatus.FINISHED
+                    req.finish = FinishInfo(
+                        reason="error",
+                        message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
+                    )
+                    outputs.append(StepOutput(req, [], True, req.finish))
+                    continue
+                if req.sampling.max_new_tokens == 0:
+                    self.waiting.popleft()
+                    req.status = RequestStatus.FINISHED
+                    req.finish = FinishInfo(reason="length")
+                    outputs.append(StepOutput(req, [], True, req.finish))
+                    continue
+
+                # radix prefix match (never match the full prompt: at least
+                # one token must be computed to produce logits)
+                shared_pages: list[int] = []
+                node = None
+                if self.radix is not None:
+                    shared_pages, node = self.radix.match_prefix(prompt[:-1])
+                matched_tokens = len(shared_pages) * self.ps
+                prompt_pages_total = math.ceil(len(prompt) / self.ps)
+                need = prompt_pages_total - len(shared_pages)
+
+                if not self._ensure_free_pages(need + self.sched.watermark_pages):
+                    break  # back-pressure: wait for pages
+
+                self.waiting.popleft()
+                admitted_any = True
+                if node is not None:
+                    self.radix.lock(node)
+                req.radix_node = node
+                req.shared_pages = shared_pages
+                req.cached_tokens = matched_tokens
+                req.owned_pages = self.pool.alloc(need)
+                req.status = RequestStatus.RUNNING
+
+                slot = free_slots[0]
+                req.slot = slot
+                row = self.page_tables[slot]
+                row[:] = 0
+                all_pages = shared_pages + req.owned_pages
+                row[: len(all_pages)] = all_pages
+                self.slots[slot] = req
+
+                remaining = len(prompt) - matched_tokens
+                if remaining > self.sched.max_prefill_tokens:
+                    self._prefill_solo(req, prompt, matched_tokens, outputs)
+                else:
+                    group.append(req)
+            if group:
+                self._prefill_group(group, outputs)
+            if not admitted_any:
                 return
-            req = self.waiting[0]
-            prompt = req.all_token_ids  # includes prior output after preemption
-            if len(prompt) + 1 > self.sched.max_seq_len:
-                self.waiting.popleft()
-                req.status = RequestStatus.FINISHED
-                req.finish = FinishInfo(
-                    reason="error",
-                    message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
-                )
-                outputs.append(StepOutput(req, [], True, req.finish))
-                continue
-            if req.sampling.max_new_tokens == 0:
-                self.waiting.popleft()
-                req.status = RequestStatus.FINISHED
-                req.finish = FinishInfo(reason="length")
-                outputs.append(StepOutput(req, [], True, req.finish))
-                continue
 
-            # radix prefix match (never match the full prompt: at least one
-            # token must be computed to produce logits)
-            shared_pages: list[int] = []
-            node = None
-            if self.radix is not None:
-                shared_pages, node = self.radix.match_prefix(prompt[:-1])
-            matched_tokens = len(shared_pages) * self.ps
-            prompt_pages_total = math.ceil(len(prompt) / self.ps)
-            need = prompt_pages_total - len(shared_pages)
+    def _prefill_solo(
+        self, req: EngineRequest, prompt: list[int], matched_tokens: int,
+        outputs: list[StepOutput],
+    ) -> None:
+        """Long prompts: loop chunks under the prefill token budget."""
+        row = self.page_tables[req.slot]
+        start = matched_tokens
+        sp = req.sampling
+        tok = lp = None
+        while start < len(prompt):
+            chunk = prompt[start : start + self.sched.max_prefill_tokens]
+            tok, lp = self.runner.prefill(
+                chunk,
+                prefix_len=start,
+                page_table=row,
+                temperature=sp.temperature,
+                top_k=sp.top_k,
+                top_p=sp.top_p,
+                min_p=sp.min_p,
+            )
+            self.num_prefill_tokens += len(chunk)
+            start += len(chunk)
+        req.seq_len = len(prompt)
+        self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
 
-            if not self._ensure_free_pages(need + self.sched.watermark_pages):
-                return  # back-pressure: wait for pages
-
-            self.waiting.popleft()
-            if node is not None:
-                self.radix.lock(node)
-            req.radix_node = node
-            req.shared_pages = shared_pages
-            req.cached_tokens = matched_tokens
-            req.owned_pages = self.pool.alloc(need)
-            req.status = RequestStatus.RUNNING
-
-            slot = free_slots[0]
-            req.slot = slot
-            row = self.page_tables[slot]
-            row[:] = 0
-            all_pages = shared_pages + req.owned_pages
-            row[: len(all_pages)] = all_pages
-
-            # chunked prefill
-            start = matched_tokens
+    def _prefill_group(
+        self, group: list[EngineRequest], outputs: list[StepOutput]
+    ) -> None:
+        """Batched prefill for a group of single-chunk prompts."""
+        chunks = []
+        temps = np.zeros(len(group), np.float32)
+        topks = np.full(len(group), -1, np.int32)
+        topps = np.ones(len(group), np.float32)
+        minps = np.zeros(len(group), np.float32)
+        for i, req in enumerate(group):
+            prompt = req.all_token_ids
+            chunk = prompt[req.cached_tokens :]
+            chunks.append((chunk, req.cached_tokens, self.page_tables[req.slot]))
             sp = req.sampling
-            tok = lp = None
-            while start < len(prompt):
-                chunk = prompt[start : start + self.sched.max_prefill_tokens]
-                tok, lp = self.runner.prefill(
-                    chunk,
-                    prefix_len=start,
-                    page_table=row,
-                    temperature=sp.temperature,
-                    top_k=sp.top_k,
-                    top_p=sp.top_p,
-                    min_p=sp.min_p,
-                )
-                self.num_prefill_tokens += len(chunk)
-                start += len(chunk)
-            req.seq_len = len(prompt)
-            self.slots[slot] = req
-            self._append_token(req, tok, lp, outputs)
+            temps[i] = sp.temperature
+            topks[i] = sp.top_k
+            topps[i] = sp.top_p
+            minps[i] = sp.min_p
+            self.num_prefill_tokens += len(chunk)
+        toks, lps = self.runner.prefill_batched(chunks, temps, topks, topps, minps)
+        for i, req in enumerate(group):
+            req.seq_len = req.total_len
+            self._accept_tokens(
+                req, [int(toks[i])], [float(lps[i])], outputs, advance_seq=False
+            )
 
     def _ensure_free_pages(self, n: int) -> bool:
         if self.pool.free_count >= n:
@@ -203,10 +250,11 @@ class Scheduler:
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return
-        # ensure a page exists for each slot's next KV write; may preempt
+        horizon = max(self.sched.decode_horizon, 1)
+        # ensure pages exist for the whole horizon's KV writes; may preempt
         survivors = []
         for i, req in active:
-            if self._ensure_seq_capacity(req):
+            if self._ensure_seq_capacity(req, horizon):
                 survivors.append((i, req))
         active = [(i, r) for i, r in survivors if self.slots[i] is r]
         if not active:
@@ -230,35 +278,44 @@ class Scheduler:
             topks[idx] = sp.top_k
             topps[idx] = sp.top_p
             minps[idx] = sp.min_p
+        # padded rows: positions land beyond mp*ps so writes hit the garbage page
+        for idx in range(B_real, B):
+            positions[idx] = self.mp * self.ps
 
-        toks, lps = self.runner.decode(
-            tokens, positions, page_tables, temps, topks, topps, minps
+        toks, lps = self.runner.decode_multi(
+            tokens, positions, page_tables, temps, topks, topps, minps, horizon
         )
-        self.num_decode_tokens += B_real
+        self.num_decode_tokens += B_real * horizon
         for idx, (slot, req) in enumerate(active):
-            req.seq_len += 1
-            self._append_token(req, int(toks[idx]), float(lps[idx]), outputs)
+            self._accept_tokens(
+                req,
+                [int(t) for t in toks[idx]],
+                [float(x) for x in lps[idx]],
+                outputs,
+                advance_seq=True,
+            )
 
-    def _ensure_seq_capacity(self, req: EngineRequest) -> bool:
-        """Make sure a page exists for position ``req.seq_len``.  Returns False
-        if the request had to be preempted."""
-        needed = math.ceil((req.seq_len + 1) / self.ps)
+    def _ensure_seq_capacity(self, req: EngineRequest, n_tokens: int = 1) -> bool:
+        """Make sure pages exist for positions seq_len..seq_len+n_tokens-1.
+        Returns False if the request had to be preempted."""
+        limit = min(req.seq_len + n_tokens, self.sched.max_seq_len)
+        needed = math.ceil(limit / self.ps)
         have = len(req.shared_pages) + len(req.owned_pages)
-        if needed <= have:
-            return True
-        if not self._ensure_free_pages(1):
-            victim = self._pick_preemption_victim(req)
-            if victim is None:
-                # nothing else to preempt: preempt this request itself
-                self._preempt(req)
-                return False
-            self._preempt(victim)
+        while needed > have:
             if not self._ensure_free_pages(1):
-                self._preempt(req)
-                return False
-        page = self.pool.alloc(1)[0]
-        req.owned_pages.append(page)
-        self.page_tables[req.slot][needed - 1] = page
+                victim = self._pick_preemption_victim(req)
+                if victim is None:
+                    # nothing else to preempt: preempt this request itself
+                    self._preempt(req)
+                    return False
+                self._preempt(victim)
+                if not self._ensure_free_pages(1):
+                    self._preempt(req)
+                    return False
+            page = self.pool.alloc(1)[0]
+            req.owned_pages.append(page)
+            self.page_tables[req.slot][have] = page
+            have += 1
         return True
 
     def _pick_preemption_victim(self, requester: EngineRequest) -> EngineRequest | None:
@@ -290,24 +347,39 @@ class Scheduler:
 
     # ---- finish bookkeeping ----
 
-    def _append_token(
-        self, req: EngineRequest, tok: int, lp: float, outputs: list[StepOutput]
+    def _accept_tokens(
+        self,
+        req: EngineRequest,
+        toks: list[int],
+        lps: list[float],
+        outputs: list[StepOutput],
+        advance_seq: bool,
     ) -> None:
-        req.output_ids.append(tok)
-        req.logprobs.append(lp)
+        """Accept sampled tokens in order until a stop condition; overshoot
+        beyond the stop (decode horizon) is discarded — its KV writes landed
+        in owned pages past seq_len, which never enter the radix cache."""
         sp = req.sampling
+        accepted: list[int] = []
         finish: FinishInfo | None = None
-        if not sp.ignore_eos and tok in self.config.model.eos_token_ids:
-            finish = FinishInfo(reason="stop", matched_stop=tok)
-        elif tok in sp.stop_token_ids:
-            finish = FinishInfo(reason="stop", matched_stop=tok)
-        elif len(req.output_ids) >= sp.max_new_tokens:
-            finish = FinishInfo(reason="length")
-        elif req.total_len >= self.sched.max_seq_len:
-            finish = FinishInfo(reason="length")
+        for tok, lp in zip(toks, lps):
+            if advance_seq:
+                req.seq_len += 1
+            req.output_ids.append(tok)
+            req.logprobs.append(lp)
+            accepted.append(tok)
+            if not sp.ignore_eos and tok in self.config.model.eos_token_ids:
+                finish = FinishInfo(reason="stop", matched_stop=tok)
+            elif tok in sp.stop_token_ids:
+                finish = FinishInfo(reason="stop", matched_stop=tok)
+            elif len(req.output_ids) >= sp.max_new_tokens:
+                finish = FinishInfo(reason="length")
+            elif req.total_len >= self.sched.max_seq_len:
+                finish = FinishInfo(reason="length")
+            if finish is not None:
+                break
         if finish is not None:
             self._release(req, finish)
-        outputs.append(StepOutput(req, [tok], finish is not None, finish))
+        outputs.append(StepOutput(req, accepted, finish is not None, finish))
 
     def finish_request(self, rid: str, reason: str, matched_stop=None) -> None:
         """External finish (e.g. the engine found a stop string)."""
@@ -326,7 +398,10 @@ class Scheduler:
             self.slots[req.slot] = None
             req.slot = None
 
-        tokens = req.all_token_ids
+        # Only tokens whose KV is actually written may enter the radix cache:
+        # the final sampled token is never fed back, so its position has no KV
+        # (inserting it would poison shared prefixes with a garbage slot).
+        tokens = req.all_token_ids[: req.seq_len]
         full_pages = len(tokens) // self.ps
         n_shared = len(req.shared_pages)
         to_free: list[int] = []

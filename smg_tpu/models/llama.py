@@ -25,9 +25,11 @@ import jax.numpy as jnp
 from smg_tpu.models.config import ModelConfig
 from smg_tpu.ops.attention import (
     attention_decode,
+    attention_decode_cached,
     attention_prefill,
+    attention_prefill_batched,
     gather_seq_kv,
-    scatter_kv_pages,
+    scatter_kv_pages_full,
 )
 from smg_tpu.ops.norms import rms_norm
 from smg_tpu.ops.rope import apply_rope
@@ -89,8 +91,9 @@ def logical_axes(cfg: ModelConfig) -> Params:
 
 
 def kv_cache_logical_axes() -> tuple[str | None, ...]:
-    # [L, P, ps, K, D] — kv_heads sharded on tp, pages replicated per dp replica
-    return ("layers", "pages", None, "kv_heads", "head_dim")
+    # [L, P, ps, K*D] — fused kv lanes sharded on tp (contiguous chunks of the
+    # fused dim are whole kv-head groups), pages replicated per dp replica
+    return ("layers", "pages", None, "kv_lanes")
 
 
 def embed_tokens(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -124,7 +127,7 @@ def forward_prefill(
     tokens: jnp.ndarray,  # [T] padded to bucket
     prefix_len: jnp.ndarray,  # scalar: tokens already cached (radix hit)
     t_real: jnp.ndarray,  # scalar: valid new tokens (<= T)
-    k_cache: jnp.ndarray,  # [L, P, ps, K, D]
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D] (fused lane layout)
     v_cache: jnp.ndarray,
     page_table: jnp.ndarray,  # [mp] pages owned by this sequence
 ):
@@ -135,29 +138,34 @@ def forward_prefill(
     scale = 1.0 / math.sqrt(cfg.head_dim)
 
     pos = prefix_len + jnp.arange(T)  # [T]
-    valid = jnp.arange(T) < t_real
+    # padded rows and out-of-range positions write to the garbage page (0);
+    # clamping instead would clobber a real slot
+    valid = (jnp.arange(T) < t_real) & (pos < mp * ps)
     pos_c = jnp.minimum(pos, mp * ps - 1)
     dest = jnp.where(valid, page_table[pos_c // ps] * ps + pos_c % ps, 0)
     ctx_len = prefix_len + t_real
 
     h = embed_tokens(params, cfg, tokens)
 
-    def layer_body(h, xs):
-        layer, k_pages, v_pages = xs
+    def layer_body(carry, xs):
+        h, k_cache, v_cache = carry
+        layer, l = xs
         hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(layer, cfg, hn)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
-        k_pages, v_pages = scatter_kv_pages(k_pages, v_pages, k, v, dest)
-        k_ctx, v_ctx = gather_seq_kv(k_pages, v_pages, page_table)
+        k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
+        k_ctx, v_ctx = gather_seq_kv(k_cache[l], v_cache[l], page_table, cfg.num_kv_heads)
         attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
         h = h + jnp.einsum("thd,hde->te", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn)
-        return h, (k_pages, v_pages)
+        return (h, k_cache, v_cache), None
 
-    h, (k_cache, v_cache) = jax.lax.scan(
-        layer_body, h, (params["layers"], k_cache, v_cache)
+    (h, k_cache, v_cache), _ = jax.lax.scan(
+        layer_body,
+        (h, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.num_layers)),
     )
     last = jnp.take_along_axis(
         h, jnp.maximum(t_real - 1, 0)[None, None].astype(jnp.int32), axis=0
@@ -172,39 +180,186 @@ def forward_decode(
     inv_freq: jnp.ndarray,
     tokens: jnp.ndarray,  # [B] one token per slot
     positions: jnp.ndarray,  # [B] position of that token (= ctx_len - 1)
-    k_cache: jnp.ndarray,  # [L, P, ps, K, D]
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D] (fused lane layout)
     v_cache: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, mp]; inactive rows all-zero -> garbage page
 ):
-    """One decode step for the whole batch; returns (logits [B, V], caches)."""
+    """One decode step for the whole batch (compat path: XLA attention only —
+    the serving hot path is ``forward_decode_horizon``); returns
+    (logits [B, V], caches)."""
     B = tokens.shape[0]
     ps = k_cache.shape[2]
     mp = page_tables.shape[1]
     scale = 1.0 / math.sqrt(cfg.head_dim)
 
+    # out-of-range positions (e.g. decode horizon overshooting a finished
+    # sequence) write to the garbage page instead of clobbering a real slot
+    valid = positions < mp * ps
     pos_c = jnp.minimum(positions, mp * ps - 1)
-    dest = jnp.take_along_axis(page_tables, (pos_c // ps)[:, None], axis=1)[:, 0] * ps + pos_c % ps
+    page = jnp.take_along_axis(page_tables, (pos_c // ps)[:, None], axis=1)[:, 0]
+    dest = jnp.where(valid, page * ps + pos_c % ps, 0)
 
     h = embed_tokens(params, cfg, tokens)  # [B, E]
 
-    def layer_body(h, xs):
-        layer, k_pages, v_pages = xs
+    # The full stacked cache rides the scan carry and is updated with
+    # layer-indexed scatters — per-layer slice-out/stack-back would copy the
+    # whole cache every step (measured ~17 ms/step at 1B serving sizes).
+    def layer_body(carry, xs):
+        h, k_cache, v_cache = carry
+        layer, l = xs
         hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(layer, cfg, hn)  # q: [B, H, D]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        k_pages, v_pages = scatter_kv_pages(k_pages, v_pages, k, v, dest)
-        attn = attention_decode(q, k_pages, v_pages, page_tables, positions, scale)
+        k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
+        attn = attention_decode(q, k_cache[l], v_cache[l], page_tables, positions, scale)
         h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn)
-        return h, (k_pages, v_pages)
+        return (h, k_cache, v_cache), None
 
-    h, (k_cache, v_cache) = jax.lax.scan(
-        layer_body, h, (params["layers"], k_cache, v_cache)
+    (h, k_cache, v_cache), _ = jax.lax.scan(
+        layer_body,
+        (h, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.num_layers)),
     )
     logits = unembed(params, cfg, h)  # [B, V]
     return logits, k_cache, v_cache
+
+
+def forward_prefill_batched(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [G, T] padded rows (t_real=0 rows are pure padding)
+    prefix_lens: jnp.ndarray,  # [G]
+    t_reals: jnp.ndarray,  # [G]
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D]
+    v_cache: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [G, mp]
+):
+    """Prefill several sequences in one device call (fills the MXU and
+    amortizes dispatch; single-sequence prefill wastes both).  Returns
+    (last_token_logits [G, V], k_cache, v_cache)."""
+    G_, T = tokens.shape
+    ps = k_cache.shape[2]
+    mp = page_tables.shape[1]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    K, D = cfg.num_kv_heads, cfg.head_dim
+
+    pos = prefix_lens[:, None] + jnp.arange(T)[None, :]  # [G, T]
+    valid = (jnp.arange(T)[None, :] < t_reals[:, None]) & (pos < mp * ps)
+    pos_c = jnp.minimum(pos, mp * ps - 1)
+    page = jnp.take_along_axis(page_tables, pos_c // ps, axis=1)
+    dest = jnp.where(valid, page * ps + pos_c % ps, 0).reshape(-1)  # [G*T]
+    ctx_lens = prefix_lens + t_reals
+
+    h = embed_tokens(params, cfg, tokens)  # [G, T, E]
+
+    def layer_body(carry, xs):
+        h, k_cache, v_cache = carry
+        layer, l = xs
+        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, hn)  # [G, T, H/K, D]
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        k_cache, v_cache = scatter_kv_pages_full(
+            k_cache, v_cache, l, k.reshape(G_ * T, K, D), v.reshape(G_ * T, K, D), dest
+        )
+        kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
+        vl = v_cache[l][page_tables]
+        S = mp * ps
+        k_ctx = kl.reshape(G_, S, K, D)
+        v_ctx = vl.reshape(G_, S, K, D)
+        attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale)
+        h = h + jnp.einsum("gthd,hde->gte", attn, layer["wo"])
+        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp(layer, hn)
+        return (h, k_cache, v_cache), None
+
+    (h, k_cache, v_cache), _ = jax.lax.scan(
+        layer_body,
+        (h, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.num_layers)),
+    )
+    last_idx = jnp.maximum(t_reals - 1, 0)[:, None, None]  # [G, 1, 1]
+    last = jnp.take_along_axis(
+        h, jnp.broadcast_to(last_idx, (G_, 1, h.shape[-1])).astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = unembed(params, cfg, last)  # [G, V]
+    return logits, k_cache, v_cache
+
+
+def forward_decode_horizon(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [B] token fed this step
+    positions: jnp.ndarray,  # [B] absolute position of that token (entry + step)
+    entry_positions: jnp.ndarray,  # [B] cache token count at horizon entry (fixed)
+    step_idx: jnp.ndarray,  # scalar: step within the horizon (0-based)
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D] READ-ONLY during the horizon
+    v_cache: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, mp]
+    hk_all: jnp.ndarray,  # [L, B, N, K*D] horizon side buffers (carried)
+    hv_all: jnp.ndarray,
+    attn_impl: str = "xla",
+):
+    """One decode step against a frozen cache + growing side buffer.
+
+    The new K/V rows are appended to the side buffers (tiny carried arrays);
+    the caller scatters the whole horizon into the cache once per
+    ``decode_multi`` call (see ``smg_tpu/ops/pallas/decode_attention.py``
+    module docs for why the cache must not be updated inside the loop).
+    Returns (logits [B, V], hk_all, hv_all).
+    """
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    K, D = cfg.num_kv_heads, cfg.head_dim
+    B = tokens.shape[0]
+
+    h = embed_tokens(params, cfg, tokens)  # [B, E]
+
+    def layer_body(carry, xs):
+        h, hk_all, hv_all = carry
+        layer, l = xs
+        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, hn)  # [B, H/K, D]
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        k_f = k.reshape(B, K * D).astype(hk_all.dtype)
+        v_f = v.reshape(B, K * D).astype(hv_all.dtype)
+        hk_all = jax.lax.dynamic_update_slice(
+            hk_all, k_f[None, :, None, :], (l, 0, step_idx, 0)
+        )
+        hv_all = jax.lax.dynamic_update_slice(
+            hv_all, v_f[None, :, None, :], (l, 0, step_idx, 0)
+        )
+        hk_l = jax.lax.dynamic_index_in_dim(hk_all, l, 0, keepdims=False)
+        hv_l = jax.lax.dynamic_index_in_dim(hv_all, l, 0, keepdims=False)
+        if attn_impl == "pallas":
+            from smg_tpu.ops.pallas.decode_attention import paged_attention_decode_cached
+
+            attn = paged_attention_decode_cached(
+                q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
+                page_tables, entry_positions, scale,
+            )
+        else:
+            attn = attention_decode_cached(
+                q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
+                page_tables, entry_positions, scale,
+            )
+        h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
+        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp(layer, hn)
+        return (h, hk_all, hv_all), None
+
+    (h, hk_all, hv_all), _ = jax.lax.scan(
+        layer_body,
+        (h, hk_all, hv_all),
+        (params["layers"], jnp.arange(cfg.num_layers)),
+    )
+    logits = unembed(params, cfg, h)
+    return logits, hk_all, hv_all
 
 
 def forward_train(

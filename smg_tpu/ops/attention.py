@@ -1,6 +1,11 @@
 """Attention over the paged KV cache — XLA reference implementations.
 
-Layout (per layer): ``k_pages, v_pages: [num_pages, page_size, kv_heads, head_dim]``.
+Layout (per layer): ``k_pages, v_pages: [num_pages, page_size, kv_heads*head_dim]``
+— the kv-head and head-dim axes are FUSED into the lane dimension (>= 512
+lanes for standard configs).  This keeps the trailing dim a multiple of the
+TPU 128-lane tile for any head_dim, so page views/reshapes are bitcasts and
+the Pallas kernels DMA pages without relayout copies (head_dim 64 unfused
+would lane-pad 64->128 and every cache reshape would copy ~0.5 GB).
 Sequences own an ordered list of pages (``page_table``); the radix prefix cache
 shares page prefixes between sequences (``smg_tpu/engine/radix_cache.py``).
 Page 0 is reserved as a garbage page: padded/inactive tokens scatter there.
@@ -20,30 +25,57 @@ NEG_INF = -1e30
 
 
 def scatter_kv_pages(
-    k_pages: jnp.ndarray,  # [P, ps, K, D]
+    k_pages: jnp.ndarray,  # [P, ps, KD]
     v_pages: jnp.ndarray,
     k_new: jnp.ndarray,  # [T, K, D]
     v_new: jnp.ndarray,
     dest_slots: jnp.ndarray,  # [T] flat slot index (page*ps + offset); 0..ps-1 => garbage page
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    P, ps, K, D = k_pages.shape
-    k_flat = k_pages.reshape(P * ps, K, D)
-    v_flat = v_pages.reshape(P * ps, K, D)
-    k_flat = k_flat.at[dest_slots].set(k_new.astype(k_flat.dtype))
-    v_flat = v_flat.at[dest_slots].set(v_new.astype(v_flat.dtype))
-    return k_flat.reshape(P, ps, K, D), v_flat.reshape(P, ps, K, D)
+    P, ps, KD = k_pages.shape
+    T = k_new.shape[0]
+    k_flat = k_pages.reshape(P * ps, KD)
+    v_flat = v_pages.reshape(P * ps, KD)
+    k_flat = k_flat.at[dest_slots].set(k_new.reshape(T, KD).astype(k_flat.dtype))
+    v_flat = v_flat.at[dest_slots].set(v_new.reshape(T, KD).astype(v_flat.dtype))
+    return k_flat.reshape(P, ps, KD), v_flat.reshape(P, ps, KD)
+
+
+def scatter_kv_pages_full(
+    k_cache: jnp.ndarray,  # [L, P, ps, KD] — FULL stacked cache
+    v_cache: jnp.ndarray,
+    layer: jnp.ndarray,  # scalar layer index
+    k_new: jnp.ndarray,  # [T, K, D]
+    v_new: jnp.ndarray,
+    dest_slots: jnp.ndarray,  # [T]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter into the full cache with the layer index folded into the
+    scatter — no per-layer slice-out/slice-in, so when the cache is a loop
+    carry the write stays in place (the slice/stack dance costs a full layer
+    copy per layer per step)."""
+    L, P, ps, KD = k_cache.shape
+    T = k_new.shape[0]
+    k_flat = k_cache.reshape(L, P * ps, KD)
+    v_flat = v_cache.reshape(L, P * ps, KD)
+    k_flat = k_flat.at[layer, dest_slots].set(k_new.reshape(T, KD).astype(k_flat.dtype))
+    v_flat = v_flat.at[layer, dest_slots].set(v_new.reshape(T, KD).astype(v_flat.dtype))
+    return k_flat.reshape(k_cache.shape), v_flat.reshape(v_cache.shape)
 
 
 def gather_seq_kv(
-    k_pages: jnp.ndarray,  # [P, ps, K, D]
+    k_pages: jnp.ndarray,  # [P, ps, KD]
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # [max_pages] page ids for one sequence
+    num_kv_heads: int,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Materialize one sequence's KV contiguously: [max_pages*ps, K, D]."""
-    k = k_pages[page_table]  # [max_pages, ps, K, D]
+    k = k_pages[page_table]  # [max_pages, ps, KD]
     v = v_pages[page_table]
-    mp, ps, K, D = k.shape
-    return k.reshape(mp * ps, K, D), v.reshape(mp * ps, K, D)
+    mp, ps, KD = k.shape
+    K = num_kv_heads
+    return (
+        k.reshape(mp * ps, K, KD // K),
+        v.reshape(mp * ps, K, KD // K),
+    )
 
 
 def attention_prefill(
@@ -70,9 +102,80 @@ def attention_prefill(
     return out.reshape(T, H, D).astype(q.dtype)
 
 
+def attention_prefill_batched(
+    q: jnp.ndarray,  # [G, T, H, D] (new tokens per sequence, post-rope)
+    k_ctx: jnp.ndarray,  # [G, S, K, D] per-sequence contiguous KV
+    v_ctx: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [G, T] global positions
+    ctx_lens: jnp.ndarray,  # [G] valid tokens per row
+    scale: float,
+) -> jnp.ndarray:
+    """Batched multi-sequence prefill attention (one row per sequence)."""
+    G_, T, H, D = q.shape
+    S = k_ctx.shape[1]
+    K = k_ctx.shape[2]
+    Gq = H // K
+    qf = q.astype(jnp.float32).reshape(G_, T, K, Gq, D)
+    kf = k_ctx.astype(jnp.float32)
+    vf = v_ctx.astype(jnp.float32)
+    scores = jnp.einsum("gtkhd,gskd->gtkhs", qf, kf) * scale  # [G, T, K, Gq, S]
+    j = jnp.arange(S)
+    mask = (j[None, None, :] <= q_positions[:, :, None]) & (
+        j[None, None, :] < ctx_lens[:, None, None]
+    )  # [G, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("gtkhs,gskd->gtkhd", probs, vf)
+    return out.reshape(G_, T, H, D).astype(q.dtype)
+
+
+def attention_decode_cached(
+    q: jnp.ndarray,  # [B, H, D]
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D] read-only cache (fused lanes)
+    v_cache: jnp.ndarray,
+    hk: jnp.ndarray,  # [B, N, K*D] horizon side buffer (this layer)
+    hv: jnp.ndarray,
+    n_extra,  # scalar: valid side rows (current token included)
+    layer,  # scalar layer index
+    page_tables: jnp.ndarray,  # [B, mp]
+    entry_positions: jnp.ndarray,  # [B] cache token count at horizon entry
+    scale: float,
+) -> jnp.ndarray:
+    """XLA fallback for the horizon-decode attention: cache pages (tokens <
+    entry) plus the first n_extra side-buffer rows, one joint softmax.
+    Mirrors ``smg_tpu/ops/pallas/decode_attention.py``."""
+    B, H, D = q.shape
+    L, P, ps, KD = k_cache.shape
+    K = KD // D
+    N = hk.shape[1]
+    G = H // K
+    kl = k_cache[layer][page_tables]  # [B, mp, ps, KD]
+    vl = v_cache[layer][page_tables]
+    mp = kl.shape[1]
+    S = mp * ps
+    kl = kl.reshape(B, S, K, D).astype(jnp.float32)
+    vl = vl.reshape(B, S, K, D).astype(jnp.float32)
+    hk4 = hk.reshape(B, N, K, D).astype(jnp.float32)
+    hv4 = hv.reshape(B, N, K, D).astype(jnp.float32)
+    k_all = jnp.concatenate([kl, hk4], axis=1)  # [B, S+N, K, D]
+    v_all = jnp.concatenate([vl, hv4], axis=1)
+    qf = q.astype(jnp.float32).reshape(B, K, G, D)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qf, k_all) * scale
+    j = jnp.arange(S + N)
+    mask = jnp.where(
+        j[None, :] < S,
+        j[None, :] < entry_positions[:, None],
+        (j[None, :] - S) < n_extra,
+    )
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_all)
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def attention_decode(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence (post-rope)
-    k_pages: jnp.ndarray,  # [P, ps, K, D]
+    k_pages: jnp.ndarray,  # [P, ps, KD]
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, max_pages]
     positions: jnp.ndarray,  # [B] position of the new token (= ctx len - 1)
@@ -85,8 +188,9 @@ def attention_decode(
     instead of materializing the gather.
     """
     B, H, D = q.shape
-    P, ps, K, _ = k_pages.shape
-    k = k_pages[page_tables]  # [B, mp, ps, K, D]
+    P, ps, KD = k_pages.shape
+    K = KD // D
+    k = k_pages[page_tables]  # [B, mp, ps, KD]
     v = v_pages[page_tables]
     mp = k.shape[1]
     S = mp * ps

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the serving hot path.
+
+Each kernel has an XLA fallback in ``smg_tpu/ops/attention.py``; dispatch
+picks the kernel on TPU backends (override with SMG_DISABLE_PALLAS=1).
+"""

@@ -27,6 +27,7 @@ class ShardingRules:
             "embed": None,
             "q_heads": "tp",
             "kv_heads": "tp",
+            "kv_lanes": "tp",
             "head_dim": None,
             "ffn": "tp",
             "experts": "ep",

@@ -179,3 +179,65 @@ def test_loads_reporting(engine):
     loads = engine.loads()
     assert loads["num_running"] == 0
     assert loads["free_pages"] > 0
+
+
+def test_radix_never_caches_unwritten_final_token(engine):
+    """The final sampled token's KV is never written (it is never fed back);
+    its page must not enter the radix cache (regression: poisoned prefix)."""
+    engine.flush_cache()
+    prompt = list(range(100, 170))  # 70 tokens; +10 outputs = exactly 5 pages
+    r1 = engine.generate(prompt_ids=prompt, sampling=greedy(10))
+    # 81 tokens: the 5th page (holding the unwritten final-token slot) would
+    # be matched if it had been inserted
+    ext = prompt + r1.token_ids + [55]
+    r2 = engine.generate(prompt_ids=ext, sampling=greedy(5))  # warm (radix hit)
+    # only 4 pages (64 tokens) may match: the 5th page holds position 79,
+    # whose KV was never written
+    assert r2.cached_tokens == 64
+    engine.flush_cache()
+    r3 = engine.generate(prompt_ids=ext, sampling=greedy(5))  # cold
+    assert r2.token_ids == r3.token_ids
+
+
+def test_decode_horizon_matches_single_step():
+    """Multi-step decode (lax.scan horizon) must be semantically identical to
+    single-step: same tokens, same stops, overshoot discarded."""
+    e1 = make_engine()
+    e4 = make_engine(decode_horizon=4)
+    prompts = [list(range(10, 40)), list(range(50, 75)), list(range(80, 101))]
+    for p in prompts:
+        r1 = e1.generate(prompt_ids=p, sampling=greedy(9))  # 9 % 4 != 0: mid-horizon length stop
+        r4 = e4.generate(prompt_ids=p, sampling=greedy(9))
+        assert r1.token_ids == r4.token_ids
+        assert r4.finish_reason == "length"
+    # stop token mid-horizon
+    probe = e1.generate(prompt_ids=prompts[0], sampling=greedy(6))
+    stop_tok = probe.token_ids[2]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=16, ignore_eos=True,
+                        stop_token_ids=[stop_tok])
+    ra = e1.generate(prompt_ids=prompts[0], sampling=sp)
+    rb = e4.generate(prompt_ids=prompts[0], sampling=sp)
+    assert ra.token_ids == rb.token_ids
+    assert rb.finish_reason == "stop" and rb.token_ids[-1] == stop_tok
+    # prefix cache integrity with horizon overshoot: warm results must equal cold
+    ext = prompts[0] + ra.token_ids
+    warm = e4.generate(prompt_ids=ext + [7], sampling=greedy(5))
+    e4.flush_cache()
+    cold = e4.generate(prompt_ids=ext + [7], sampling=greedy(5))
+    assert warm.token_ids == cold.token_ids
+
+
+def test_horizon_stop_string_trims_overshoot_tokens():
+    """With decode_horizon > 1, tokens sampled after a stop string in the same
+    horizon must not appear in the output (review finding)."""
+    e1 = make_engine()
+    e4 = make_engine(decode_horizon=4)
+    probe = e1.generate(prompt_ids=list(range(60, 75)), sampling=greedy(8))
+    stop_word = f"w{probe.token_ids[2]}"
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12, ignore_eos=True, stop=[stop_word])
+    r1 = e1.generate(prompt_ids=list(range(60, 75)), sampling=sp)
+    r4 = e4.generate(prompt_ids=list(range(60, 75)), sampling=sp)
+    assert r4.finish_reason == "stop"
+    assert r4.token_ids == r1.token_ids, (r1.token_ids, r4.token_ids)
+    assert r4.text == r1.text
+    assert stop_word not in r4.text

@@ -23,7 +23,7 @@ def setup():
 
 
 def _empty_cache(cfg, num_pages=32, page_size=16):
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads * cfg.head_dim)
     return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
 
