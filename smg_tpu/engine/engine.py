@@ -110,6 +110,11 @@ class Engine:
         with self._lock:
             return self.scheduler.flush_cache()
 
+    def embed(self, batches: "list[list[int]]"):
+        """Sequence embeddings (blocks the step loop briefly)."""
+        with self._lock:
+            return self.runner.embed(batches)
+
     # ---- stepping ----
 
     def step(self) -> list[RequestOutput]:

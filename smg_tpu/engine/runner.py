@@ -453,6 +453,37 @@ class ModelRunner:
         )
         return np.asarray(toks), np.asarray(lps)
 
+    def embed(self, batches: "list[list[int]]") -> np.ndarray:
+        """Sequence embeddings for a batch of token-id lists: [n, hidden]."""
+        n = len(batches)
+        B = 1
+        while B < n:
+            B *= 2
+        cap = max(self.config.scheduler.prefill_token_buckets)
+        # embeddings truncate at the context budget (OpenAI-style) rather than fail
+        batches = [b[:cap] for b in batches]
+        t_max = max(len(b) for b in batches)
+        T = self.config.scheduler.prefill_bucket(t_max)
+        tokens = np.zeros((B, T), np.int32)
+        lengths = np.zeros(B, np.int32)
+        for i, ids in enumerate(batches):
+            tokens[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+        key = ("embed", B, T)
+        if key not in self._compiled:
+            cfg = self.model_cfg
+            module = self.module
+            fn = jax.jit(
+                lambda params, inv_freq, toks, lens: module.forward_embed(
+                    params, cfg, inv_freq, toks, lens
+                )
+            )
+            self._compiled[key] = fn
+        out = self._compiled[key](
+            self.params, self.inv_freq, jnp.asarray(tokens), jnp.asarray(lengths)
+        )
+        return np.asarray(out)[:n]
+
     def flush_cache_buffers(self) -> None:
         """Zero the KV buffers (used by flush_cache after the radix reset)."""
         self.k_cache, self.v_cache = create_kv_buffers(self.spec, self.kv_sharding)

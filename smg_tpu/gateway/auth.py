@@ -1,0 +1,110 @@
+"""Auth: API keys with roles, optional HMAC-signed bearer tokens, audit log.
+
+Reference: ``crates/auth`` (smg-auth) — control-plane JWT/OIDC + API keys with
+roles + audit (SURVEY.md §2.2).  JWKS/OIDC discovery needs egress, so the
+in-tree verifier covers API keys and HS256 JWTs; the middleware seam matches
+the reference so an OIDC verifier can slot in.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.auth")
+
+
+@dataclass
+class Principal:
+    id: str
+    roles: tuple[str, ...] = ("user",)
+    tenant: str = "default"
+
+
+@dataclass
+class AuthConfig:
+    enabled: bool = False
+    api_keys: dict[str, Principal] = field(default_factory=dict)  # key -> principal
+    jwt_secret: str | None = None  # enables HS256 bearer verification
+    # routes that skip auth (probes)
+    public_paths: tuple[str, ...] = ("/health", "/liveness", "/readiness", "/metrics")
+
+
+class AuthError(Exception):
+    def __init__(self, message: str, status: int = 401):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def verify_hs256(token: str, secret: str) -> dict:
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+    except ValueError:
+        raise AuthError("malformed token")
+    header = json.loads(_b64url_decode(header_b64))
+    if header.get("alg") != "HS256":
+        raise AuthError(f"unsupported alg {header.get('alg')}")
+    expected = hmac.new(
+        secret.encode(), f"{header_b64}.{payload_b64}".encode(), hashlib.sha256
+    ).digest()
+    if not hmac.compare_digest(expected, _b64url_decode(sig_b64)):
+        raise AuthError("bad signature")
+    payload = json.loads(_b64url_decode(payload_b64))
+    if "exp" in payload and payload["exp"] < time.time():
+        raise AuthError("token expired")
+    return payload
+
+
+class Authenticator:
+    def __init__(self, config: AuthConfig):
+        self.config = config
+        self.audit: list[dict] = []  # bounded audit ring
+
+    def authenticate(self, path: str, headers) -> Principal | None:
+        """Returns the principal, or None when auth is disabled/public.
+        Raises AuthError when credentials are missing/invalid."""
+        if not self.config.enabled or path in self.config.public_paths:
+            return None
+        authz = headers.get("Authorization", "")
+        api_key = headers.get("X-API-Key") or (
+            authz[7:] if authz.startswith("Bearer ") else None
+        )
+        if not api_key:
+            raise AuthError("missing credentials")
+        principal = self.config.api_keys.get(api_key)
+        if principal is None and self.config.jwt_secret:
+            payload = verify_hs256(api_key, self.config.jwt_secret)
+            principal = Principal(
+                id=str(payload.get("sub", "jwt-user")),
+                roles=tuple(payload.get("roles", ["user"])),
+                tenant=str(payload.get("tenant", "default")),
+            )
+        if principal is None:
+            self._audit("denied", path, None)
+            raise AuthError("invalid credentials", 403)
+        self._audit("allowed", path, principal)
+        return principal
+
+    def _audit(self, outcome: str, path: str, principal: Principal | None) -> None:
+        self.audit.append(
+            {
+                "ts": time.time(),
+                "outcome": outcome,
+                "path": path,
+                "principal": principal.id if principal else None,
+            }
+        )
+        if len(self.audit) > 10000:
+            del self.audit[:5000]

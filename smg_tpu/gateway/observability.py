@@ -1,0 +1,95 @@
+"""Metrics + request tracing.
+
+Reference: ``model_gateway/src/observability/`` — 45 ``record_*`` metric
+functions, Prometheus exporter, OTel tracing, runtime self-metrics
+(SURVEY.md §2.1, §5).  prometheus_client here; tracing is a lightweight
+span-event log with request-id correlation (OTLP export is a deploy concern —
+the hook points match).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.observability")
+
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Metrics:
+    """Gateway metric set (names mirror the reference's smg_* metrics)."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+        self.requests_total = Counter(
+            "smg_requests_total", "Requests received", ["route", "status"], registry=r
+        )
+        self.request_duration = Histogram(
+            "smg_request_duration_seconds", "End-to-end request latency", ["route"],
+            buckets=LATENCY_BUCKETS, registry=r,
+        )
+        self.ttft = Histogram(
+            "smg_time_to_first_token_seconds", "Time to first streamed token", ["route"],
+            buckets=LATENCY_BUCKETS, registry=r,
+        )
+        self.generated_tokens = Counter(
+            "smg_generated_tokens_total", "Tokens generated", registry=r
+        )
+        self.prompt_tokens = Counter(
+            "smg_prompt_tokens_total", "Prompt tokens processed", registry=r
+        )
+        self.cached_tokens = Counter(
+            "smg_cached_prompt_tokens_total", "Prompt tokens served from prefix cache",
+            registry=r,
+        )
+        self.in_flight = Gauge(
+            "smg_in_flight_requests", "Requests currently executing", registry=r
+        )
+        self.worker_load = Gauge(
+            "smg_worker_load", "Gateway-tracked per-worker in-flight requests",
+            ["worker_id"], registry=r,
+        )
+        self.worker_healthy = Gauge(
+            "smg_worker_healthy", "Worker health (1 healthy / 0 not)",
+            ["worker_id"], registry=r,
+        )
+        self.retries_total = Counter(
+            "smg_request_retries_total", "Dispatch retries", registry=r
+        )
+        self.rate_limited_total = Counter(
+            "smg_rate_limited_total", "Requests rejected by rate limiting", registry=r
+        )
+        self.queue_wait = Histogram(
+            "smg_scheduler_queue_wait_seconds", "Priority-scheduler queue wait",
+            ["priority"], buckets=LATENCY_BUCKETS, registry=r,
+        )
+
+    def export(self) -> bytes:
+        return generate_latest(self.registry)
+
+    @contextmanager
+    def track_request(self, route: str):
+        start = time.perf_counter()
+        self.in_flight.inc()
+        status = "200"
+        try:
+            yield
+        except Exception:
+            status = "error"
+            raise
+        finally:
+            self.in_flight.dec()
+            self.requests_total.labels(route=route, status=status).inc()
+            self.request_duration.labels(route=route).observe(time.perf_counter() - start)

@@ -1,0 +1,113 @@
+"""Priority scheduler: admission control with per-class queues.
+
+Reference: ``model_gateway/src/middleware/scheduler/`` (4,291 LoC) — SlotPool
++ per-class FIFO queues with classes system/interactive/default/bulk and a
+preemption budget (SURVEY.md §2.1).  Async variant: a fixed slot pool; a
+request waits in its class queue until a slot frees; higher classes always
+drain first; per-class max queue wait produces 503s instead of unbounded
+queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+CLASS_ORDER = ("system", "interactive", "default", "bulk")
+
+
+@dataclass
+class PriorityConfig:
+    slots: int = 256
+    classes: tuple[str, ...] = CLASS_ORDER
+    max_queue: dict = field(default_factory=lambda: {"bulk": 4096, "default": 2048,
+                                                     "interactive": 1024, "system": 256})
+    max_wait_secs: dict = field(default_factory=lambda: {"bulk": 120.0, "default": 30.0,
+                                                         "interactive": 10.0, "system": 5.0})
+
+
+class AdmissionRejected(Exception):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class SlotGuard:
+    def __init__(self, scheduler: "PriorityScheduler"):
+        self._sched = scheduler
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._sched._release()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        self.release()
+
+
+class PriorityScheduler:
+    def __init__(self, config: PriorityConfig | None = None):
+        self.config = config or PriorityConfig()
+        self._free = self.config.slots
+        self._waiters: dict[str, asyncio.Queue] = {}
+        self._queues: dict[str, list] = {c: [] for c in self.config.classes}
+        self._lock = asyncio.Lock()
+        self.stats = {c: {"admitted": 0, "rejected": 0} for c in self.config.classes}
+
+    def classify(self, headers) -> str:
+        c = (headers.get("X-SMG-Priority") or headers.get("Priority") or "default").lower()
+        return c if c in self.config.classes else "default"
+
+    async def admit(self, priority: str = "default") -> SlotGuard:
+        """Waits for a slot; raises AdmissionRejected on queue overflow or
+        wait timeout."""
+        async with self._lock:
+            if self._free > 0 and not any(self._queues[c] for c in self.config.classes):
+                self._free -= 1
+                self.stats[priority]["admitted"] += 1
+                return SlotGuard(self)
+            if len(self._queues[priority]) >= self.config.max_queue.get(priority, 1024):
+                self.stats[priority]["rejected"] += 1
+                raise AdmissionRejected(f"{priority} queue full")
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._queues[priority].append(fut)
+        timeout = self.config.max_wait_secs.get(priority, 30.0)
+        try:
+            await asyncio.wait_for(fut, timeout=timeout)
+        except asyncio.TimeoutError:
+            async with self._lock:
+                if fut in self._queues[priority]:
+                    self._queues[priority].remove(fut)
+            self.stats[priority]["rejected"] += 1
+            raise AdmissionRejected(f"{priority} admission timed out after {timeout}s")
+        self.stats[priority]["admitted"] += 1
+        return SlotGuard(self)
+
+    def _release(self) -> None:
+        loop = asyncio.get_event_loop()
+
+        async def _do():
+            async with self._lock:
+                # wake the highest-priority waiter, else free the slot
+                for c in self.config.classes:
+                    q = self._queues[c]
+                    while q:
+                        fut = q.pop(0)
+                        if not fut.done():
+                            fut.set_result(None)
+                            return
+                self._free += 1
+
+        loop.create_task(_do())
+
+    def describe(self) -> dict:
+        return {
+            "free_slots": self._free,
+            "queued": {c: len(q) for c, q in self._queues.items()},
+            "stats": self.stats,
+        }

@@ -1,0 +1,309 @@
+"""Responses API handler with the agentic MCP tool loop.
+
+Reference: ``src/routers/openai/mcp/tool_loop.rs:41-50`` + responses store
+(SURVEY.md §3.4): iterate chat executions; parsed tool calls resolvable in an
+MCP server run server-side and their outputs feed the next iteration;
+unresolvable (client-executed) function calls are surfaced in the response
+output.  Conversation history loads from a conversation id or the
+previous_response_id chain; completed responses persist via ResponseStorage.
+"""
+
+from __future__ import annotations
+
+import json
+
+from smg_tpu.gateway.router import RouteError, Router
+from smg_tpu.mcp import McpRegistry
+from smg_tpu.protocols.openai import ChatCompletionRequest, ChatMessage, FunctionDef, Tool
+from smg_tpu.protocols.responses import (
+    ResponseFunctionCallItem,
+    ResponseMessageItem,
+    ResponseOutputText,
+    ResponsesRequest,
+    ResponsesResponse,
+    ResponseUsage,
+)
+from smg_tpu.storage import ConversationItem, MemoryStorage, StoredResponse
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.responses")
+
+DEFAULT_MAX_TOOL_ITERATIONS = 10
+
+
+class ResponsesHandler:
+    def __init__(self, router: Router, storage=None, mcp: McpRegistry | None = None):
+        self.router = router
+        self.storage = storage or MemoryStorage()
+        self.mcp = mcp or McpRegistry()
+
+    # ---- history assembly ----
+
+    async def _build_messages(self, req: ResponsesRequest) -> list[ChatMessage]:
+        messages: list[ChatMessage] = []
+        if req.instructions:
+            messages.append(ChatMessage(role="system", content=req.instructions))
+
+        if req.conversation:
+            items = await self.storage.list_items(req.conversation)
+            for it in items:
+                messages.extend(self._item_to_messages(it.type, it.role, it.content))
+        elif req.previous_response_id:
+            chain = await self.storage.response_chain(req.previous_response_id)
+            if not chain:
+                raise RouteError(404, f"response {req.previous_response_id} not found")
+            for resp in chain:
+                for item in resp.input_items:
+                    messages.extend(
+                        self._item_to_messages(
+                            item.get("type", "message"), item.get("role"), item
+                        )
+                    )
+                for item in resp.output:
+                    messages.extend(
+                        self._item_to_messages(
+                            item.get("type", "message"), item.get("role", "assistant"), item
+                        )
+                    )
+
+        # current input
+        if isinstance(req.input, str):
+            messages.append(ChatMessage(role="user", content=req.input))
+        else:
+            for item in req.input:
+                messages.extend(
+                    self._item_to_messages(
+                        item.get("type", "message"), item.get("role"), item
+                    )
+                )
+        return messages
+
+    def _item_to_messages(self, item_type: str, role, content) -> list[ChatMessage]:
+        if item_type == "message":
+            if isinstance(content, dict):
+                c = content.get("content")
+                if isinstance(c, list):
+                    text = "".join(
+                        p.get("text", "") for p in c
+                        if p.get("type") in ("input_text", "output_text", "text")
+                    )
+                else:
+                    text = c or ""
+                return [ChatMessage(role=content.get("role") or role or "user", content=text)]
+            return [ChatMessage(role=role or "user", content=str(content))]
+        if item_type == "function_call":
+            name = content.get("name", "") if isinstance(content, dict) else ""
+            args = content.get("arguments", "{}") if isinstance(content, dict) else "{}"
+            return [
+                ChatMessage(
+                    role="assistant", content=None,
+                    tool_calls=[{
+                        "id": content.get("call_id", "call_0"),
+                        "type": "function",
+                        "function": {"name": name, "arguments": args},
+                    }],
+                )
+            ]
+        if item_type == "function_call_output":
+            return [
+                ChatMessage(
+                    role="tool",
+                    content=content.get("output", "") if isinstance(content, dict) else str(content),
+                    tool_call_id=content.get("call_id") if isinstance(content, dict) else None,
+                )
+            ]
+        return []
+
+    def _assemble_tools(self, req: ResponsesRequest) -> tuple[list[Tool], McpRegistry]:
+        """Function tools for the model + an MCP registry for server-side
+        execution (gateway-level servers plus request-level mcp tools)."""
+        fn_tools: list[Tool] = []
+        mcp = self.mcp
+        req_servers = []
+        for t in req.tools or []:
+            if t.get("type") == "function":
+                f = t.get("function", t)
+                fn_tools.append(
+                    Tool(function=FunctionDef(
+                        name=f.get("name", ""),
+                        description=f.get("description"),
+                        parameters=f.get("parameters"),
+                    ))
+                )
+            elif t.get("type") == "mcp" and t.get("server_url"):
+                from smg_tpu.mcp import HttpMcpServer
+
+                req_servers.append(
+                    HttpMcpServer(
+                        name=t.get("server_label", t["server_url"]),
+                        url=t["server_url"],
+                        headers=t.get("headers"),
+                    )
+                )
+        if req_servers:
+            merged = McpRegistry()
+            for name in mcp.servers:
+                merged.add(mcp._servers[name])
+            for s in req_servers:
+                merged.add(s)
+            mcp = merged
+        return fn_tools, mcp
+
+    # ---- the loop ----
+
+    async def create(self, req: ResponsesRequest, request_id: str | None = None) -> ResponsesResponse:
+        messages = await self._build_messages(req)
+        fn_tools, mcp = self._assemble_tools(req)
+        mcp_tools = await mcp.list_tools()
+        mcp_names = {t.name for t in mcp_tools}
+        all_tools = fn_tools + [
+            Tool(function=FunctionDef(
+                name=t.name, description=t.description, parameters=t.input_schema
+            ))
+            for t in mcp_tools
+        ]
+
+        output_items: list[dict] = []
+        usage = ResponseUsage()
+        max_iters = req.max_tool_calls or DEFAULT_MAX_TOOL_ITERATIONS
+        status = "completed"
+
+        for iteration in range(max_iters):
+            chat_req = ChatCompletionRequest(
+                model=req.model,
+                messages=messages,
+                tools=all_tools or None,
+                temperature=req.temperature,
+                top_p=req.top_p,
+                max_tokens=req.max_output_tokens,
+            )
+            resp = await self.router.chat(chat_req, request_id=f"{request_id or 'resp'}-{iteration}")
+            choice = resp.choices[0]
+            usage.input_tokens += resp.usage.prompt_tokens
+            usage.output_tokens += resp.usage.completion_tokens
+
+            if choice.message.content:
+                output_items.append(
+                    ResponseMessageItem(
+                        content=[ResponseOutputText(text=choice.message.content)]
+                    ).model_dump()
+                )
+            calls = choice.message.tool_calls or []
+            if not calls:
+                break
+
+            # split server-side (MCP) vs client-executed calls
+            client_calls = []
+            assistant_msg = ChatMessage(role="assistant", content=choice.message.content,
+                                        tool_calls=calls)
+            messages.append(assistant_msg)
+            for tc in calls:
+                fc_item = ResponseFunctionCallItem(
+                    call_id=tc.id or f"call_{iteration}",
+                    name=tc.function.name or "",
+                    arguments=tc.function.arguments or "{}",
+                )
+                output_items.append(fc_item.model_dump())
+                if tc.function.name in mcp_names:
+                    try:
+                        args = json.loads(tc.function.arguments or "{}")
+                    except json.JSONDecodeError:
+                        args = {}
+                    try:
+                        result = await mcp.call_tool(tc.function.name, args)
+                    except Exception as e:
+                        result = f"tool error: {e}"
+                    output_items.append(
+                        {
+                            "type": "function_call_output",
+                            "call_id": fc_item.call_id,
+                            "output": result,
+                        }
+                    )
+                    messages.append(
+                        ChatMessage(role="tool", content=result, tool_call_id=tc.id)
+                    )
+                else:
+                    client_calls.append(tc)
+            if client_calls:
+                # client must execute these: stop the loop and return
+                status = "completed"
+                break
+        else:
+            status = "incomplete"
+
+        usage.total_tokens = usage.input_tokens + usage.output_tokens
+        response = ResponsesResponse(
+            model=req.model or "default",
+            status=status,
+            output=output_items,
+            previous_response_id=req.previous_response_id,
+            conversation={"id": req.conversation} if req.conversation else None,
+            usage=usage,
+            metadata=req.metadata or {},
+        )
+
+        if req.store:
+            input_items = (
+                [{"type": "message", "role": "user", "content": req.input}]
+                if isinstance(req.input, str)
+                else list(req.input)
+            )
+            await self.storage.store_response(
+                StoredResponse(
+                    id=response.id,
+                    previous_response_id=req.previous_response_id,
+                    conversation_id=req.conversation,
+                    status=status,
+                    model=response.model,
+                    output=output_items,
+                    input_items=input_items,
+                    usage=usage.model_dump(),
+                    metadata=req.metadata or {},
+                )
+            )
+        if req.conversation:
+            items = []
+            if isinstance(req.input, str):
+                items.append(ConversationItem(
+                    type="message", role="user",
+                    content={"role": "user", "content": req.input},
+                ))
+            else:
+                for it in req.input:
+                    items.append(ConversationItem(
+                        type=it.get("type", "message"), role=it.get("role"), content=it
+                    ))
+            for it in output_items:
+                items.append(ConversationItem(
+                    type=it.get("type", "message"), role=it.get("role", "assistant"),
+                    content=it,
+                ))
+            await self.storage.add_items(req.conversation, items)
+        return response
+
+    async def create_stream(self, req: ResponsesRequest, request_id: str | None = None):
+        """Responses streaming events (subset): response.created,
+        response.output_item.added, response.output_text.delta,
+        response.output_item.done, response.completed."""
+        seq = 0
+
+        def ev(name: str, payload: dict):
+            nonlocal seq
+            seq += 1
+            return name, {"type": name, "sequence_number": seq, **payload}
+
+        # run the loop non-streaming for tool iterations, then re-emit
+        response = await self.create(req, request_id=request_id)
+        yield ev("response.created", {"response": {"id": response.id, "status": "in_progress"}})
+        for idx, item in enumerate(response.output):
+            yield ev("response.output_item.added", {"output_index": idx, "item": item})
+            if item.get("type") == "message":
+                for c in item.get("content", []):
+                    if c.get("type") == "output_text" and c.get("text"):
+                        yield ev(
+                            "response.output_text.delta",
+                            {"output_index": idx, "delta": c["text"]},
+                        )
+            yield ev("response.output_item.done", {"output_index": idx, "item": item})
+        yield ev("response.completed", {"response": response.model_dump()})

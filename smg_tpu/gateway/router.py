@@ -31,6 +31,8 @@ from smg_tpu.protocols.openai import (
     CompletionChoice,
     CompletionRequest,
     CompletionResponse,
+    FunctionCall,
+    ToolCall,
     UsageInfo,
 )
 from smg_tpu.protocols.sampling import SamplingParams
@@ -54,6 +56,9 @@ class RouterConfig:
     max_retries: int = 3
     retry_backoff_base: float = 0.1
     retry_backoff_max: float = 2.0
+    # parser selection: None = auto by model name; "passthrough" disables
+    reasoning_parser: str | None = None
+    tool_parser: str | None = None
 
 
 @dataclass
@@ -253,10 +258,42 @@ class Router:
                 text_parts.append(ev.text_delta)
                 last = ev
             assert last is not None
+            text = "".join(text_parts)
+
+            reasoning_content = None
+            if req.separate_reasoning:
+                from smg_tpu.parsers import get_reasoning_parser
+
+                rp = get_reasoning_parser(self.config.reasoning_parser or req.model)
+                text, reasoning = rp.parse_full(text)
+                reasoning_content = reasoning or None
+
+            tool_calls = None
+            finish = last.finish_reason or "stop"
+            if req.tools:
+                from smg_tpu.parsers import get_tool_parser
+
+                tp = get_tool_parser(self.config.tool_parser or req.model)
+                text, parsed = tp.parse_full(text)
+                if parsed:
+                    tool_calls = [
+                        ToolCall(
+                            id=c.id, index=c.index,
+                            function=FunctionCall(name=c.name, arguments=c.arguments),
+                        )
+                        for c in parsed
+                    ]
+                    finish = "tool_calls"
+
             choice = ChatCompletionChoice(
                 index=choice_idx,
-                message=ChatMessage(role="assistant", content="".join(text_parts)),
-                finish_reason=last.finish_reason or "stop",
+                message=ChatMessage(
+                    role="assistant",
+                    content=text or (None if tool_calls else ""),
+                    tool_calls=tool_calls,
+                    reasoning_content=reasoning_content,
+                ),
+                finish_reason=finish,
             )
             return choice, last
 
@@ -294,15 +331,61 @@ class Router:
             sub_rid = rid if sampling.n == 1 else f"{rid}-{idx}"
             one_sampling = SamplingParams(**{**sampling.__dict__, "n": 1})
             first = True
+            rp = tp = None
+            if req.separate_reasoning:
+                from smg_tpu.parsers import get_reasoning_parser
+
+                rp = get_reasoning_parser(self.config.reasoning_parser or req.model)
+            if req.tools:
+                from smg_tpu.parsers import get_tool_parser
+
+                tp = get_tool_parser(self.config.tool_parser or req.model)
+            saw_tool_calls = False
+
+            def make_delta(text: str, flush: bool = False):
+                nonlocal saw_tool_calls
+                reasoning = None
+                calls = None
+                if rp is not None:
+                    d = rp.feed(text)
+                    if flush:
+                        df = rp.flush()
+                        d.content += df.content
+                        d.reasoning += df.reasoning
+                    text = d.content
+                    reasoning = d.reasoning or None
+                if tp is not None:
+                    d2 = tp.feed(text)
+                    if flush:
+                        df2 = tp.flush()
+                        d2.normal_text += df2.normal_text
+                        d2.calls.extend(df2.calls)
+                    text = d2.normal_text
+                    if d2.calls:
+                        saw_tool_calls = True
+                        calls = [
+                            ToolCall(
+                                id=c.id, index=c.index,
+                                function=FunctionCall(name=c.name, arguments=c.arguments),
+                            )
+                            for c in d2.calls
+                        ]
+                return text, reasoning, calls
+
             try:
                 async for ev in self._execute(ctx, input_ids, one_sampling, sub_rid, tokenizer):
+                    text, reasoning, calls = make_delta(ev.text_delta, flush=ev.finished)
                     delta = ChatStreamDelta(
                         role="assistant" if first else None,
-                        content=ev.text_delta if ev.text_delta else ("" if first else None),
+                        content=text if text else ("" if first else None),
+                        reasoning_content=reasoning,
+                        tool_calls=calls,
                     )
                     first = False
-                    finish = ev.finish_reason if ev.finished else None
-                    if ev.text_delta or finish or delta.role:
+                    finish = None
+                    if ev.finished:
+                        finish = "tool_calls" if saw_tool_calls else (ev.finish_reason or "stop")
+                    if text or reasoning or calls or finish or delta.role:
                         await out_q.put(
                             ChatCompletionStreamChunk(
                                 id=rid, created=created, model=model,
@@ -351,6 +434,183 @@ class Router:
             yield ChatCompletionStreamChunk(
                 id=rid, created=created, model=model, choices=[], usage=usage
             )
+
+    # ---- embeddings ----
+
+    async def embeddings(self, req, request_id: str | None = None):
+        from smg_tpu.protocols.openai import EmbeddingData, EmbeddingResponse, UsageInfo
+
+        model_id = req.model or None
+        inputs = req.input
+        batches: list[list[int]] = []
+        if isinstance(inputs, str):
+            batches.append(self.tokenizers.encode_cached(model_id, inputs))
+        elif isinstance(inputs, list) and inputs and isinstance(inputs[0], int):
+            batches.append(list(inputs))
+        elif isinstance(inputs, list) and inputs and isinstance(inputs[0], str):
+            batches = [self.tokenizers.encode_cached(model_id, s) for s in inputs]
+        elif isinstance(inputs, list) and inputs and isinstance(inputs[0], list):
+            batches = [list(x) for x in inputs]
+        else:
+            raise RouteError(400, "invalid embeddings input")
+
+        ctx = RequestContext(model_id=model_id, request_id=request_id)
+        worker = self.select_worker(ctx)
+        guard = worker.acquire()
+        try:
+            vecs = await worker.client.embed(batches)
+            data = [EmbeddingData(index=i, embedding=v) for i, v in enumerate(vecs)]
+            total_tokens = sum(len(b) for b in batches)
+            guard.release(success=True)
+        except Exception as e:
+            guard.release(success=False)
+            raise RouteError(502, f"worker embed error: {e}", "worker_error")
+        usage = UsageInfo(prompt_tokens=total_tokens, total_tokens=total_tokens)
+        return EmbeddingResponse(data=data, model=req.model or "default", usage=usage)
+
+    # ---- Anthropic Messages ----
+
+    async def anthropic_messages(self, req, request_id: str | None = None):
+        """Non-streaming Anthropic /v1/messages (reference: anthropic router)."""
+        from smg_tpu.protocols.anthropic import (
+            AnthropicContentBlock,
+            AnthropicMessagesResponse,
+            AnthropicUsage,
+            map_stop_reason,
+        )
+
+        chat_req = self._anthropic_to_chat(req)
+        resp = await self.chat(chat_req, request_id=request_id)
+        choice = resp.choices[0]
+        blocks: list[AnthropicContentBlock] = []
+        if choice.message.content:
+            blocks.append(AnthropicContentBlock(type="text", text=choice.message.content))
+        if choice.message.tool_calls:
+            import json as _json
+
+            for tc in choice.message.tool_calls:
+                try:
+                    args = _json.loads(tc.function.arguments or "{}")
+                except Exception:
+                    args = {}
+                blocks.append(
+                    AnthropicContentBlock(
+                        type="tool_use", id=tc.id, name=tc.function.name, input=args
+                    )
+                )
+        return AnthropicMessagesResponse(
+            model=req.model or "default",
+            content=blocks,
+            stop_reason=map_stop_reason(choice.finish_reason),
+            usage=AnthropicUsage(
+                input_tokens=resp.usage.prompt_tokens,
+                output_tokens=resp.usage.completion_tokens,
+                cache_read_input_tokens=(resp.usage.prompt_tokens_details or {}).get(
+                    "cached_tokens", 0
+                ),
+            ),
+        )
+
+    async def anthropic_messages_stream(self, req, request_id: str | None = None):
+        """Anthropic streaming events: message_start, content_block_start,
+        content_block_delta (text_delta), content_block_stop, message_delta,
+        message_stop."""
+        from smg_tpu.protocols.anthropic import map_stop_reason
+
+        from smg_tpu.protocols.openai import StreamOptions
+
+        chat_req = self._anthropic_to_chat(req)
+        chat_req.stream = True
+        chat_req.stream_options = StreamOptions(include_usage=True)
+        mid = f"msg_{uuid.uuid4().hex[:24]}"
+        yield "message_start", {
+            "type": "message_start",
+            "message": {
+                "id": mid, "type": "message", "role": "assistant",
+                "model": req.model or "default", "content": [],
+                "usage": {"input_tokens": 0, "output_tokens": 0},
+            },
+        }
+        finish = None
+        in_tokens = out_tokens = 0
+        block_idx = -1
+        text_block_open = False
+        async for chunk in self.chat_stream(chat_req, request_id=request_id):
+            if chunk.usage is not None:
+                in_tokens = chunk.usage.prompt_tokens
+                out_tokens = chunk.usage.completion_tokens
+                continue
+            for ch in chunk.choices:
+                if ch.delta.content:
+                    if not text_block_open:
+                        block_idx += 1
+                        text_block_open = True
+                        yield "content_block_start", {
+                            "type": "content_block_start", "index": block_idx,
+                            "content_block": {"type": "text", "text": ""},
+                        }
+                    yield "content_block_delta", {
+                        "type": "content_block_delta", "index": block_idx,
+                        "delta": {"type": "text_delta", "text": ch.delta.content},
+                    }
+                for tc in ch.delta.tool_calls or []:
+                    if text_block_open:
+                        yield "content_block_stop", {
+                            "type": "content_block_stop", "index": block_idx,
+                        }
+                        text_block_open = False
+                    block_idx += 1
+                    yield "content_block_start", {
+                        "type": "content_block_start", "index": block_idx,
+                        "content_block": {
+                            "type": "tool_use", "id": tc.id,
+                            "name": tc.function.name or "", "input": {},
+                        },
+                    }
+                    yield "content_block_delta", {
+                        "type": "content_block_delta", "index": block_idx,
+                        "delta": {
+                            "type": "input_json_delta",
+                            "partial_json": tc.function.arguments or "{}",
+                        },
+                    }
+                    yield "content_block_stop", {
+                        "type": "content_block_stop", "index": block_idx,
+                    }
+                if ch.finish_reason:
+                    finish = ch.finish_reason
+        if text_block_open:
+            yield "content_block_stop", {"type": "content_block_stop", "index": block_idx}
+        yield "message_delta", {
+            "type": "message_delta",
+            "delta": {"stop_reason": map_stop_reason(finish), "stop_sequence": None},
+            "usage": {"input_tokens": in_tokens, "output_tokens": out_tokens},
+        }
+        yield "message_stop", {"type": "message_stop"}
+
+    def _anthropic_to_chat(self, req) -> ChatCompletionRequest:
+        from smg_tpu.protocols.openai import FunctionDef, Tool
+
+        tools = None
+        if req.tools:
+            tools = [
+                Tool(function=FunctionDef(
+                    name=t.name, description=t.description, parameters=t.input_schema
+                ))
+                for t in req.tools
+            ]
+        return ChatCompletionRequest(
+            model=req.model,
+            messages=[ChatMessage.model_validate(m) for m in req.to_chat_messages()],
+            max_tokens=req.max_tokens,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=req.top_k,
+            stop=req.stop_sequences,
+            tools=tools,
+            stream=req.stream,
+            stream_options=None,
+        )
 
     # ---- completions ----
 

@@ -44,14 +44,51 @@ class AppContext:
         policy: str = "cache_aware",
         router_config: RouterConfig | None = None,
         max_concurrent_requests: int = 256,
+        auth_config=None,
+        rate_limit_config=None,
+        priority_config=None,
+        health_config=None,
     ):
+        from smg_tpu.gateway.auth import AuthConfig, Authenticator
+        from smg_tpu.gateway.health import HealthMonitor
+        from smg_tpu.gateway.observability import Metrics
+        from smg_tpu.gateway.priority import PriorityConfig, PriorityScheduler
+        from smg_tpu.gateway.rate_limit import RateLimitConfig, RateLimiter
+
         self.registry = WorkerRegistry()
         self.policies = PolicyRegistry(default=policy)
         self.tokenizers = TokenizerRegistry()
         self.kv_monitor = KvEventMonitor(self.registry, self.policies)
         self.router = Router(self.registry, self.policies, self.tokenizers, router_config)
         self.semaphore = asyncio.Semaphore(max_concurrent_requests)
-        self.metrics = None  # attached by observability setup
+        self.metrics = Metrics()
+        self.auth = Authenticator(auth_config or AuthConfig())
+        self.rate_limiter = RateLimiter(
+            rate_limit_config
+            or RateLimitConfig(
+                capacity=float(max_concurrent_requests),
+                max_concurrent=max_concurrent_requests,
+            )
+        )
+        self.priority = PriorityScheduler(
+            priority_config or PriorityConfig(slots=max_concurrent_requests)
+        )
+        self.health_monitor = HealthMonitor(self.registry, health_config, self.metrics)
+        from smg_tpu.gateway.responses import ResponsesHandler
+        from smg_tpu.mcp import McpRegistry
+        from smg_tpu.storage import MemoryStorage
+
+        self.storage = MemoryStorage()
+        self.mcp = McpRegistry()
+        self.responses = ResponsesHandler(self.router, self.storage, self.mcp)
+
+
+INFERENCE_ROUTES = frozenset(
+    {
+        "/v1/chat/completions", "/v1/completions", "/generate",
+        "/v1/messages", "/v1/embeddings",
+    }
+)
 
 
 def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
@@ -98,10 +135,72 @@ async def error_middleware(request: web.Request, handler):
         return _error(500, f"internal error: {e}", "internal_error")
 
 
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.gateway.auth import AuthError
+
+    try:
+        principal = ctx.auth.authenticate(request.path, request.headers)
+    except AuthError as e:
+        return _error(e.status, e.message, "authentication_error")
+    request["principal"] = principal
+    request["tenant"] = (
+        principal.tenant if principal else request.headers.get("X-Tenant-Id", "default")
+    )
+    return await handler(request)
+
+
+@web.middleware
+async def admission_middleware(request: web.Request, handler):
+    """Rate limit + priority-scheduler admission on inference routes
+    (reference: token_bucket + scheduler middleware layers)."""
+    ctx: AppContext = request.app["ctx"]
+    if request.path not in INFERENCE_ROUTES:
+        return await handler(request)
+    tenant = request.get("tenant", "default")
+    if not ctx.rate_limiter.try_acquire(tenant):
+        ctx.metrics.rate_limited_total.inc()
+        return _error(429, f"rate limit exceeded for tenant {tenant!r}", "rate_limit_error")
+    from smg_tpu.gateway.priority import AdmissionRejected
+
+    priority = ctx.priority.classify(request.headers)
+    import time as _time
+
+    q_start = _time.perf_counter()
+    try:
+        guard = await ctx.priority.admit(priority)
+    except AdmissionRejected as e:
+        ctx.rate_limiter.release(tenant)
+        return _error(503, str(e), "overloaded_error")
+    ctx.metrics.queue_wait.labels(priority=priority).observe(_time.perf_counter() - q_start)
+    try:
+        with ctx.metrics.track_request(request.path):
+            return await handler(request)
+    finally:
+        guard.release()
+        ctx.rate_limiter.release(tenant)
+
+
 def build_app(ctx: AppContext) -> web.Application:
-    app = web.Application(middlewares=[request_id_middleware, error_middleware])
+    app = web.Application(
+        middlewares=[
+            request_id_middleware, error_middleware, auth_middleware, admission_middleware,
+        ]
+    )
     app["ctx"] = ctx
 
+    async def _start_background(app):
+        ctx.health_monitor.start()
+
+    async def _stop_background(app):
+        ctx.health_monitor.stop()
+
+    app.on_startup.append(_start_background)
+    app.on_cleanup.append(_stop_background)
+
+    app.router.add_get("/metrics", h_metrics)
+    app.router.add_get("/scheduler", h_scheduler_stats)
     app.router.add_get("/health", h_health)
     app.router.add_get("/liveness", h_health)
     app.router.add_get("/readiness", h_readiness)
@@ -111,8 +210,21 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_post("/v1/chat/completions", h_chat)
     app.router.add_post("/v1/completions", h_completions)
     app.router.add_post("/generate", h_generate)
+    app.router.add_post("/v1/embeddings", h_embeddings)
+    app.router.add_post("/v1/messages", h_anthropic_messages)
+    app.router.add_post("/parse/function_call", h_parse_function_call)
+    app.router.add_post("/parse/reasoning", h_parse_reasoning)
     app.router.add_post("/v1/tokenize", h_tokenize)
     app.router.add_post("/v1/detokenize", h_detokenize)
+    app.router.add_post("/v1/responses", h_responses_create)
+    app.router.add_get("/v1/responses/{response_id}", h_responses_get)
+    app.router.add_delete("/v1/responses/{response_id}", h_responses_delete)
+    app.router.add_post("/v1/conversations", h_conv_create)
+    app.router.add_get("/v1/conversations/{conv_id}", h_conv_get)
+    app.router.add_post("/v1/conversations/{conv_id}", h_conv_update)
+    app.router.add_delete("/v1/conversations/{conv_id}", h_conv_delete)
+    app.router.add_get("/v1/conversations/{conv_id}/items", h_conv_items_list)
+    app.router.add_post("/v1/conversations/{conv_id}/items", h_conv_items_add)
     app.router.add_get("/get_loads", h_get_loads)
     app.router.add_post("/flush_cache", h_flush_cache)
     app.router.add_get("/workers", h_workers_list)
@@ -122,6 +234,16 @@ def build_app(ctx: AppContext) -> web.Application:
 
 
 # ---- probes / info ----
+
+async def h_metrics(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    return web.Response(body=ctx.metrics.export(), content_type="text/plain")
+
+
+async def h_scheduler_stats(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response(ctx.priority.describe())
+
 
 async def h_health(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok", "version": __version__})
@@ -306,6 +428,74 @@ async def h_generate(request: web.Request) -> web.Response | web.StreamResponse:
         return sse
 
 
+async def h_embeddings(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.openai import EmbeddingRequest
+
+    try:
+        req = EmbeddingRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    async with ctx.semaphore:
+        resp = await ctx.router.embeddings(req, request_id=request["request_id"])
+        return web.json_response(resp.model_dump())
+
+
+async def h_anthropic_messages(request: web.Request) -> web.Response | web.StreamResponse:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.anthropic import AnthropicMessagesRequest
+
+    try:
+        req = AnthropicMessagesRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    rid = request["request_id"]
+    async with ctx.semaphore:
+        if not req.stream:
+            resp = await ctx.router.anthropic_messages(req, request_id=rid)
+            return web.json_response(resp.model_dump(exclude_none=True))
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        try:
+            async for event_name, payload in ctx.router.anthropic_messages_stream(req, request_id=rid):
+                await sse.write(
+                    f"event: {event_name}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+        except RouteError as e:
+            err = {"type": "error", "error": {"type": e.err_type, "message": e.message}}
+            await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def h_parse_function_call(request: web.Request) -> web.Response:
+    """Parser-only endpoint (reference: /parse/function_call)."""
+    body = await request.json()
+    from smg_tpu.parsers import get_tool_parser
+
+    parser = get_tool_parser(body.get("tool_call_parser") or body.get("model"))
+    normal, calls = parser.parse_full(body.get("text", ""))
+    return web.json_response(
+        {
+            "normal_text": normal,
+            "calls": [
+                {"name": c.name, "arguments": c.arguments, "id": c.id, "index": c.index}
+                for c in calls
+            ],
+        }
+    )
+
+
+async def h_parse_reasoning(request: web.Request) -> web.Response:
+    """Parser-only endpoint (reference: /parse/reasoning)."""
+    body = await request.json()
+    from smg_tpu.parsers import get_reasoning_parser
+
+    parser = get_reasoning_parser(body.get("reasoning_parser") or body.get("model"))
+    content, reasoning = parser.parse_full(body.get("text", ""))
+    return web.json_response({"text": content, "reasoning_text": reasoning})
+
+
 # ---- tokenize/detokenize ----
 
 async def h_tokenize(request: web.Request) -> web.Response:
@@ -328,6 +518,148 @@ async def h_detokenize(request: web.Request) -> web.Response:
     ids = body.get("tokens") or []
     text = tok.decode(ids, skip_special_tokens=body.get("skip_special_tokens", True))
     return web.json_response({"text": text})
+
+
+# ---- responses / conversations ----
+
+async def h_responses_create(request: web.Request) -> web.Response | web.StreamResponse:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.responses import ResponsesRequest
+
+    try:
+        req = ResponsesRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    rid = request["request_id"]
+    async with ctx.semaphore:
+        if not req.stream:
+            resp = await ctx.responses.create(req, request_id=rid)
+            return web.json_response(resp.model_dump(exclude_none=True))
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        try:
+            async for name, payload in ctx.responses.create_stream(req, request_id=rid):
+                await sse.write(f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode())
+        except RouteError as e:
+            err = {"type": "error", "error": {"message": e.message, "type": e.err_type}}
+            await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def h_responses_get(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    stored = await ctx.storage.get_response(request.match_info["response_id"])
+    if stored is None:
+        return _error(404, "response not found")
+    return web.json_response(
+        {
+            "id": stored.id,
+            "object": "response",
+            "created_at": int(stored.created_at),
+            "status": stored.status,
+            "model": stored.model,
+            "output": stored.output,
+            "previous_response_id": stored.previous_response_id,
+            "usage": stored.usage,
+            "metadata": stored.metadata,
+        }
+    )
+
+
+async def h_responses_delete(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    rid = request.match_info["response_id"]
+    if not await ctx.storage.delete_response(rid):
+        return _error(404, "response not found")
+    return web.json_response({"id": rid, "object": "response", "deleted": True})
+
+
+def _conv_json(conv) -> dict:
+    return {
+        "id": conv.id, "object": "conversation",
+        "created_at": int(conv.created_at), "metadata": conv.metadata,
+    }
+
+
+async def h_conv_create(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    body = await request.json() if request.can_read_body else {}
+    conv = await ctx.storage.create_conversation(body.get("metadata") or {})
+    if body.get("items"):
+        from smg_tpu.storage import ConversationItem
+
+        await ctx.storage.add_items(
+            conv.id,
+            [
+                ConversationItem(
+                    type=i.get("type", "message"), role=i.get("role"), content=i
+                )
+                for i in body["items"]
+            ],
+        )
+    return web.json_response(_conv_json(conv))
+
+
+async def h_conv_get(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    conv = await ctx.storage.get_conversation(request.match_info["conv_id"])
+    if conv is None:
+        return _error(404, "conversation not found")
+    return web.json_response(_conv_json(conv))
+
+
+async def h_conv_update(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    body = await request.json()
+    conv = await ctx.storage.update_conversation(
+        request.match_info["conv_id"], body.get("metadata") or {}
+    )
+    if conv is None:
+        return _error(404, "conversation not found")
+    return web.json_response(_conv_json(conv))
+
+
+async def h_conv_delete(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    cid = request.match_info["conv_id"]
+    if not await ctx.storage.delete_conversation(cid):
+        return _error(404, "conversation not found")
+    return web.json_response({"id": cid, "object": "conversation.deleted", "deleted": True})
+
+
+async def h_conv_items_list(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    cid = request.match_info["conv_id"]
+    if await ctx.storage.get_conversation(cid) is None:
+        return _error(404, "conversation not found")
+    items = await ctx.storage.list_items(cid)
+    return web.json_response(
+        {
+            "object": "list",
+            "data": [
+                {"id": i.id, "type": i.type, "role": i.role, "content": i.content,
+                 "created_at": int(i.created_at)}
+                for i in items
+            ],
+        }
+    )
+
+
+async def h_conv_items_add(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.storage import ConversationItem
+
+    cid = request.match_info["conv_id"]
+    if await ctx.storage.get_conversation(cid) is None:
+        return _error(404, "conversation not found")
+    body = await request.json()
+    items = [
+        ConversationItem(type=i.get("type", "message"), role=i.get("role"), content=i)
+        for i in body.get("items", [])
+    ]
+    await ctx.storage.add_items(cid, items)
+    return web.json_response({"object": "list", "data": [{"id": i.id} for i in items]})
 
 
 # ---- ops ----

@@ -51,6 +51,10 @@ class WorkerClient:
     async def abort(self, rid: str) -> bool:
         raise NotImplementedError
 
+    async def embed(self, batches: list) -> list:
+        """batches: list[list[int]] -> list[list[float]]."""
+        raise NotImplementedError
+
     async def health(self) -> bool:
         raise NotImplementedError
 
@@ -108,6 +112,13 @@ class InProcWorkerClient(WorkerClient):
 
     async def abort(self, rid: str) -> bool:
         return self.engine.abort(rid)
+
+    async def embed(self, batches: list) -> list:
+        loop = asyncio.get_running_loop()
+        vecs = await loop.run_in_executor(
+            None, self.engine.embed, [list(b) for b in batches]
+        )
+        return [v.tolist() for v in vecs]
 
     async def health(self) -> bool:
         return True

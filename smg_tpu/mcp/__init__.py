@@ -1,0 +1,18 @@
+"""MCP client orchestration (reference: ``crates/mcp`` smg-mcp, SURVEY.md §2.2):
+server inventory, sessions, tool execution with approval flow."""
+
+from smg_tpu.mcp.client import (
+    HttpMcpServer,
+    LocalToolServer,
+    McpRegistry,
+    McpToolServer,
+    ToolInfo,
+)
+
+__all__ = [
+    "McpToolServer",
+    "LocalToolServer",
+    "HttpMcpServer",
+    "McpRegistry",
+    "ToolInfo",
+]

@@ -362,6 +362,51 @@ def forward_decode_horizon(
     return logits, hk_all, hv_all
 
 
+def forward_embed(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [B, T] right-padded
+    lengths: jnp.ndarray,  # [B] valid lengths
+) -> jnp.ndarray:
+    """Sequence embeddings: final-norm hidden state of the last valid token,
+    L2-normalized (serves /v1/embeddings — reference routes embeddings to
+    engine ``Embed`` RPCs, ``sglang_scheduler.proto``)."""
+    B, T = tokens.shape
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    pos = jnp.arange(T)[None, :].repeat(B, axis=0)
+    h = embed_tokens(params, cfg, tokens)
+    # causal mask also masks padding columns beyond each row's length
+    j = jnp.arange(T)
+    causal = jnp.tril(jnp.ones((T, T), bool))[None] & (j[None, None, :] < lengths[:, None, None])
+
+    def layer_body(h, layer):
+        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, hn)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        K = cfg.num_kv_heads
+        G = cfg.num_heads // K
+        qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
+        scores = jnp.where(causal[:, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+        attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
+        h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
+        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        h = h + _mlp(layer, hn)
+        return h, None
+
+    h, _ = jax.lax.scan(layer_body, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        h, jnp.maximum(lengths - 1, 0)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0].astype(jnp.float32)
+    norm = jnp.linalg.norm(last, axis=-1, keepdims=True)
+    return last / jnp.maximum(norm, 1e-12)
+
+
 def forward_train(
     params: Params,
     cfg: ModelConfig,

@@ -44,6 +44,16 @@ class GrpcWorkerClient(WorkerClient):
             request_serializer=pb.GenerateRequestProto.SerializeToString,
             response_deserializer=pb.GenerateChunk.FromString,
         )
+        self._embed = c.unary_unary(
+            method("Embed"),
+            request_serializer=pb.EmbedRequestProto.SerializeToString,
+            response_deserializer=pb.EmbedResponseProto.FromString,
+        )
+        self._embed_batch = c.unary_unary(
+            method("EmbedBatch"),
+            request_serializer=pb.EmbedBatchRequestProto.SerializeToString,
+            response_deserializer=pb.EmbedBatchResponseProto.FromString,
+        )
         self._abort = c.unary_unary(
             method("Abort"),
             request_serializer=pb.AbortRequestProto.SerializeToString,
@@ -100,6 +110,16 @@ class GrpcWorkerClient(WorkerClient):
                 )
         finally:
             call.cancel()
+
+    async def embed(self, batches: list) -> list:
+        """batches: list[list[int]] -> list[list[float]] (one RPC)."""
+        req = pb.EmbedBatchRequestProto(rid="embed")
+        for ids in batches:
+            req.inputs.add(ids=ids)
+        resp = await self._embed_batch(req, timeout=300)
+        if resp.error:
+            raise RuntimeError(f"worker embed error: {resp.error}")
+        return [list(v.values) for v in resp.embeddings]
 
     async def abort(self, rid: str) -> bool:
         try:

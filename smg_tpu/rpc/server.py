@@ -65,6 +65,34 @@ class SchedulerServicer:
             # client went away mid-stream: stop generating
             self.engine.abort(rid)
 
+    async def Embed(self, request: pb.EmbedRequestProto, context):
+        loop = asyncio.get_running_loop()
+        try:
+            vec = await loop.run_in_executor(
+                None, self.engine.embed, [list(request.input_ids)]
+            )
+            return pb.EmbedResponseProto(
+                embedding=vec[0].tolist(), prompt_tokens=len(request.input_ids)
+            )
+        except Exception as e:
+            logger.exception("embed failed")
+            return pb.EmbedResponseProto(error=str(e))
+
+    async def EmbedBatch(self, request: pb.EmbedBatchRequestProto, context):
+        loop = asyncio.get_running_loop()
+        try:
+            batches = [list(t.ids) for t in request.inputs]
+            vecs = await loop.run_in_executor(None, self.engine.embed, batches)
+            resp = pb.EmbedBatchResponseProto(
+                prompt_tokens=sum(len(b) for b in batches)
+            )
+            for v in vecs:
+                resp.embeddings.add(values=v.tolist())
+            return resp
+        except Exception as e:
+            logger.exception("embed batch failed")
+            return pb.EmbedBatchResponseProto(error=str(e))
+
     async def Abort(self, request: pb.AbortRequestProto, context):
         return pb.AbortResponseProto(ok=self.engine.abort(request.rid))
 
@@ -118,6 +146,16 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             servicer.Generate,
             request_deserializer=pb.GenerateRequestProto.FromString,
             response_serializer=pb.GenerateChunk.SerializeToString,
+        ),
+        "Embed": grpc.unary_unary_rpc_method_handler(
+            servicer.Embed,
+            request_deserializer=pb.EmbedRequestProto.FromString,
+            response_serializer=pb.EmbedResponseProto.SerializeToString,
+        ),
+        "EmbedBatch": grpc.unary_unary_rpc_method_handler(
+            servicer.EmbedBatch,
+            request_deserializer=pb.EmbedBatchRequestProto.FromString,
+            response_serializer=pb.EmbedBatchResponseProto.SerializeToString,
         ),
         "Abort": grpc.unary_unary_rpc_method_handler(
             servicer.Abort,

@@ -1,0 +1,29 @@
+"""Chat-history storage (reference: ``crates/data_connector``, SURVEY.md §2.2).
+
+Storage traits (``ConversationStorage``/``ConversationItemStorage``/
+``ResponseStorage``, reference ``core.rs:132,225,434``) with in-memory and
+SQLite backends (the reference ships memory/noop/oracle/postgres/redis; SQLite
+is the in-tree durable stand-in with the same migration discipline).
+"""
+
+from smg_tpu.storage.core import (
+    Conversation,
+    ConversationItem,
+    ConversationItemStorage,
+    ConversationStorage,
+    ResponseStorage,
+    StoredResponse,
+)
+from smg_tpu.storage.memory import MemoryStorage
+from smg_tpu.storage.sqlite import SqliteStorage
+
+__all__ = [
+    "Conversation",
+    "ConversationItem",
+    "ConversationStorage",
+    "ConversationItemStorage",
+    "ResponseStorage",
+    "StoredResponse",
+    "MemoryStorage",
+    "SqliteStorage",
+]

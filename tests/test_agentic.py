@@ -1,0 +1,330 @@
+"""Responses API + conversations + MCP tool loop + storage backends
+(reference: e2e responses/messages suites + data_connector tests)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient
+from smg_tpu.gateway.workers import Worker
+from smg_tpu.mcp import LocalToolServer
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.storage import ConversationItem, MemoryStorage, SqliteStorage, StoredResponse
+from smg_tpu.tokenizer import MockTokenizer
+
+
+# ---- storage backends ----
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_storage_backend_roundtrip(backend):
+    async def go():
+        s = MemoryStorage() if backend == "memory" else SqliteStorage(":memory:")
+        conv = await s.create_conversation({"topic": "x"})
+        assert (await s.get_conversation(conv.id)).metadata == {"topic": "x"}
+        await s.update_conversation(conv.id, {"y": 1})
+        assert (await s.get_conversation(conv.id)).metadata == {"topic": "x", "y": 1}
+
+        items = [
+            ConversationItem(type="message", role="user", content={"content": "hi"}),
+            ConversationItem(type="message", role="assistant", content={"content": "yo"}),
+        ]
+        await s.add_items(conv.id, items)
+        got = await s.list_items(conv.id)
+        assert [i.role for i in got] == ["user", "assistant"]
+        assert await s.delete_item(conv.id, got[0].id)
+        assert len(await s.list_items(conv.id)) == 1
+
+        r1 = await s.store_response(StoredResponse(model="m", output=[{"type": "message"}]))
+        r2 = await s.store_response(
+            StoredResponse(model="m", previous_response_id=r1.id)
+        )
+        chain = await s.response_chain(r2.id)
+        assert [r.id for r in chain] == [r1.id, r2.id]
+        assert await s.delete_response(r1.id)
+        assert await s.get_conversation("nope") is None
+        assert await s.delete_conversation(conv.id)
+        assert await s.get_conversation(conv.id) is None
+
+    asyncio.run(go())
+
+
+# ---- mcp ----
+
+def test_local_mcp_server_and_registry():
+    async def go():
+        from smg_tpu.mcp import McpRegistry
+
+        srv = LocalToolServer("test")
+        srv.register("add", lambda a, b: {"sum": a + b}, "adds numbers",
+                     {"type": "object", "properties": {"a": {}, "b": {}}})
+        reg = McpRegistry()
+        reg.add(srv)
+        tools = await reg.list_tools()
+        assert tools[0].name == "add"
+        result = await reg.call_tool("add", {"a": 2, "b": 3})
+        assert '"sum": 5' in result
+        with pytest.raises(KeyError):
+            await reg.call_tool("nope", {})
+
+    asyncio.run(go())
+
+
+# ---- gateway fixture ----
+
+@pytest.fixture(scope="module")
+def agw():
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+    engine = Engine(
+        EngineConfig(
+            model=tiny_test_config(),
+            cache=CacheConfig(page_size=16, num_pages=256, auto_size=False, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=8, max_seq_len=256, max_prefill_tokens=64,
+                prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4, 8),
+            ),
+            dtype="float32",
+            model_id="tiny-test",
+        )
+    )
+
+    async def _setup():
+        ctx.registry.add(
+            Worker(worker_id="w0", client=InProcWorkerClient(engine), model_id="tiny-test")
+        )
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=120)
+
+    tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.client, h.ctx = run, tc, ctx
+    yield h
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+def test_conversation_crud(agw):
+    async def go():
+        r = await agw.client.post("/v1/conversations", json={"metadata": {"t": "demo"}})
+        conv = await r.json()
+        r2 = await agw.client.get(f"/v1/conversations/{conv['id']}")
+        r3 = await agw.client.post(
+            f"/v1/conversations/{conv['id']}/items",
+            json={"items": [{"type": "message", "role": "user", "content": "w1 w2"}]},
+        )
+        r4 = await agw.client.get(f"/v1/conversations/{conv['id']}/items")
+        r5 = await agw.client.delete(f"/v1/conversations/{conv['id']}")
+        r6 = await agw.client.get(f"/v1/conversations/{conv['id']}")
+        return conv, (await r2.json()), (await r4.json()), r5.status, r6.status
+
+    conv, got, items, del_status, gone_status = agw.run(go())
+    assert got["id"] == conv["id"]
+    assert got["metadata"] == {"t": "demo"}
+    assert len(items["data"]) == 1
+    assert del_status == 200 and gone_status == 404
+
+
+def test_responses_create_and_retrieve(agw):
+    async def go():
+        r = await agw.client.post(
+            "/v1/responses",
+            json={"model": "tiny-test", "input": "w5 w6 w7",
+                  "max_output_tokens": 6, "temperature": 0},
+        )
+        resp = await r.json()
+        r2 = await agw.client.get(f"/v1/responses/{resp['id']}")
+        r3 = await agw.client.delete(f"/v1/responses/{resp['id']}")
+        r4 = await agw.client.get(f"/v1/responses/{resp['id']}")
+        return r.status, resp, (await r2.json()), r3.status, r4.status
+
+    status, resp, got, del_status, gone = agw.run(go())
+    assert status == 200
+    assert resp["object"] == "response"
+    assert resp["status"] == "completed"
+    msg = next(i for i in resp["output"] if i["type"] == "message")
+    assert msg["content"][0]["text"].startswith("w")
+    assert got["id"] == resp["id"]
+    assert del_status == 200 and gone == 404
+
+
+def test_responses_chaining(agw):
+    async def go():
+        r1 = await agw.client.post(
+            "/v1/responses",
+            json={"model": "tiny-test", "input": "w1 w2", "max_output_tokens": 4,
+                  "temperature": 0},
+        )
+        first = await r1.json()
+        r2 = await agw.client.post(
+            "/v1/responses",
+            json={"model": "tiny-test", "input": "w3 w4", "max_output_tokens": 4,
+                  "temperature": 0, "previous_response_id": first["id"]},
+        )
+        return first, await r2.json()
+
+    first, second = agw.run(go())
+    assert second["previous_response_id"] == first["id"]
+    assert second["status"] == "completed"
+
+
+def test_responses_mcp_tool_loop(agw):
+    """Wire a fake worker that emits a tool call on the first turn and plain
+    text on the second, plus a local MCP tool — the loop must execute the tool
+    server-side and produce both items."""
+    ctx = agw.ctx
+
+    calls_made = []
+    srv = LocalToolServer("calc")
+    srv.register("add", lambda a, b: (calls_made.append((a, b)), {"sum": a + b})[1],
+                 "adds", {"type": "object"})
+    ctx.mcp.add(srv)
+
+    from smg_tpu.gateway.worker_client import WorkerClient, WorkerStreamChunk
+
+    class ScriptedClient(WorkerClient):
+        """Protocol-accurate fake worker (reference: crates/mock_worker)."""
+
+        def __init__(self, scripts):
+            self.scripts = scripts
+            self.turn = 0
+
+        async def generate(self, req):
+            text = self.scripts[min(self.turn, len(self.scripts) - 1)]
+            self.turn += 1
+            ids = self.tokenizer.encode(text)
+            yield WorkerStreamChunk(
+                rid=req.rid, token_ids=ids, finished=True, finish_reason="stop",
+                prompt_tokens=len(req.input_ids), output_tokens=len(ids),
+            )
+
+        async def abort(self, rid):
+            return True
+
+        async def health(self):
+            return True
+
+        async def get_loads(self):
+            return {"num_waiting": 0, "num_running": 0, "free_pages": 1,
+                    "cached_pages": 0, "total_pages": 1}
+
+        async def flush_cache(self):
+            return True
+
+    # scripted output needs arbitrary text to round-trip through incremental
+    # detokenization: assign each encoded chunk of text its own token id
+    class TextTokenizer(MockTokenizer):
+        def __init__(self):
+            super().__init__()
+            self.pieces = {}
+            self._next = 10
+
+        def decode(self, ids, skip_special_tokens=True):
+            return "".join(self.pieces.get(int(t), "") for t in ids)
+
+        def encode(self, text, add_special_tokens=False):
+            ids = []
+            for i in range(0, len(text), 4):
+                tid = self._next
+                self._next += 1
+                self.pieces[tid] = text[i : i + 4]
+                ids.append(tid)
+            return ids
+
+    tok = TextTokenizer()
+    scripted = ScriptedClient(
+        ['{"name": "add", "arguments": {"a": 2, "b": 5}}', "the sum is seven"]
+    )
+    scripted.tokenizer = tok
+
+    async def go():
+        ctx.tokenizers.register("scripted", tok)
+        ctx.registry.add(Worker(worker_id="scripted-w", client=scripted, model_id="scripted"))
+        r = await agw.client.post(
+            "/v1/responses",
+            json={"model": "scripted", "input": "add two and five",
+                  "temperature": 0, "max_output_tokens": 16},
+        )
+        body = await r.json()
+        ctx.registry.remove("scripted-w")
+        return r.status, body
+
+    status, body = agw.run(go())
+    assert status == 200, body
+    types = [i["type"] for i in body["output"]]
+    assert "function_call" in types
+    assert "function_call_output" in types
+    fc_out = next(i for i in body["output"] if i["type"] == "function_call_output")
+    assert '"sum": 7' in fc_out["output"]
+    assert calls_made == [(2, 5)]
+    assert "message" in types  # final answer after tool result
+
+
+def test_responses_stream_events(agw):
+    async def go():
+        resp = await agw.client.post(
+            "/v1/responses",
+            json={"model": "tiny-test", "input": "w8", "max_output_tokens": 3,
+                  "temperature": 0, "stream": True},
+        )
+        return await resp.text()
+
+    raw = agw.run(go())
+    events = [l[7:] for l in raw.splitlines() if l.startswith("event: ")]
+    assert events[0] == "response.created"
+    assert "response.output_text.delta" in events
+    assert events[-1] == "response.completed"
+
+
+def test_anthropic_tool_blocks_translate():
+    """tool_use / tool_result blocks must survive translation to chat
+    messages (review finding: the standard Anthropic tool loop)."""
+    from smg_tpu.protocols.anthropic import AnthropicMessagesRequest
+
+    req = AnthropicMessagesRequest.model_validate(
+        {
+            "model": "m",
+            "max_tokens": 10,
+            "messages": [
+                {"role": "user", "content": "what is 2+5?"},
+                {
+                    "role": "assistant",
+                    "content": [
+                        {"type": "text", "text": "let me compute"},
+                        {"type": "tool_use", "id": "tu_1", "name": "add",
+                         "input": {"a": 2, "b": 5}},
+                    ],
+                },
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "tool_result", "tool_use_id": "tu_1", "content": "7"},
+                    ],
+                },
+            ],
+        }
+    )
+    msgs = req.to_chat_messages()
+    assert msgs[0]["role"] == "user"
+    assert msgs[1]["role"] == "assistant"
+    assert msgs[1]["tool_calls"][0]["function"]["name"] == "add"
+    assert msgs[2]["role"] == "tool"
+    assert msgs[2]["content"] == "7"
+    assert msgs[2]["tool_call_id"] == "tu_1"

@@ -283,3 +283,107 @@ def test_health_generate(gateway):
         return resp.status
 
     assert gateway.run(go()) == 200
+
+
+def test_chat_with_reasoning_separation(gateway):
+    """Feed the model a prompt whose greedy continuation we wrap via the
+    parser path: use a tool-call parser + reasoning parser on the router by
+    exercising the API contract (tiny model emits arbitrary tokens; here we
+    verify the plumbing accepts the fields and returns well-formed shapes)."""
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "w5"}],
+                "max_tokens": 4,
+                "temperature": 0,
+                "ignore_eos": True,
+                "separate_reasoning": True,
+                "tools": [{"type": "function", "function": {"name": "f", "parameters": {}}}],
+            },
+        )
+        return resp.status, await resp.json()
+
+    status, body = gateway.run(go())
+    assert status == 200, body
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    # tiny model emits plain tokens: no calls parsed, content passes through
+    assert body["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+def test_embeddings_endpoint(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/embeddings",
+            json={"model": "tiny-test", "input": ["w1 w2 w3", "w4 w5"]},
+        )
+        return resp.status, await resp.json()
+
+    status, body = gateway.run(go())
+    assert status == 200, body
+    assert len(body["data"]) == 2
+    v = body["data"][0]["embedding"]
+    assert len(v) == 128  # tiny hidden size
+    import math
+    assert abs(math.sqrt(sum(x * x for x in v)) - 1.0) < 1e-3  # L2 normalized
+    assert body["usage"]["prompt_tokens"] == 5
+
+
+def test_anthropic_messages(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/messages",
+            json={
+                "model": "tiny-test",
+                "max_tokens": 6,
+                "system": "be terse",
+                "messages": [{"role": "user", "content": "w5 w6"}],
+            },
+        )
+        return resp.status, await resp.json()
+
+    status, body = gateway.run(go())
+    assert status == 200, body
+    assert body["type"] == "message"
+    assert body["role"] == "assistant"
+    assert body["content"][0]["type"] == "text"
+    assert body["content"][0]["text"].startswith("w")
+    assert body["stop_reason"] == "max_tokens"
+    assert body["usage"]["output_tokens"] == 6
+
+
+def test_anthropic_messages_stream(gateway):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/messages",
+            json={
+                "model": "tiny-test", "max_tokens": 4, "stream": True,
+                "messages": [{"role": "user", "content": "w9"}],
+            },
+        )
+        return await resp.text()
+
+    raw = gateway.run(go())
+    events = [l[7:] for l in raw.splitlines() if l.startswith("event: ")]
+    assert events[0] == "message_start"
+    assert "content_block_delta" in events
+    assert events[-1] == "message_stop"
+
+
+def test_parse_endpoints(gateway):
+    async def go():
+        r1 = await gateway.client.post(
+            "/parse/function_call",
+            json={"text": '{"name": "f", "arguments": {"x": 1}}', "tool_call_parser": "json"},
+        )
+        r2 = await gateway.client.post(
+            "/parse/reasoning",
+            json={"text": "<think>hmm</think>ok", "reasoning_parser": "qwen3"},
+        )
+        return await r1.json(), await r2.json()
+
+    fc, rs = gateway.run(go())
+    assert fc["calls"][0]["name"] == "f"
+    assert rs["reasoning_text"] == "hmm" and rs["text"] == "ok"

@@ -1,0 +1,190 @@
+"""Reasoning + tool-call parser behavior tests, including chunked streaming
+(reference: per-parser test suites under crates/{reasoning,tool}_parser)."""
+
+import json
+
+import pytest
+
+from smg_tpu.parsers import get_reasoning_parser, get_tool_parser
+from smg_tpu.parsers.partial_json import complete_json, parse_partial
+
+
+def stream_chunks(parser, text, n=3):
+    """Feed text in n-char chunks; collect output."""
+    content = reasoning = ""
+    for i in range(0, len(text), n):
+        d = parser.feed(text[i : i + n])
+        content += d.content
+        reasoning += d.reasoning
+    d = parser.flush()
+    return content + d.content, reasoning + d.reasoning
+
+
+# ---- reasoning ----
+
+def test_reasoning_basic_split():
+    p = get_reasoning_parser("qwen3")
+    c, r = p.parse_full("<think>step by step</think>the answer is 4")
+    assert r == "step by step"
+    assert c == "the answer is 4"
+
+
+def test_reasoning_initial_in_reasoning():
+    p = get_reasoning_parser("deepseek-r1")
+    c, r = p.parse_full("I reason here</think>final answer")
+    assert r == "I reason here"
+    assert c == "final answer"
+
+
+def test_reasoning_streaming_split_across_chunks():
+    for chunk in (1, 2, 3, 7):
+        p = get_reasoning_parser("qwen3")
+        c, r = stream_chunks(p, "<think>abc def</think>ghi", n=chunk)
+        assert r == "abc def", f"chunk={chunk}"
+        assert c == "ghi", f"chunk={chunk}"
+
+
+def test_reasoning_no_tags_passthrough_family():
+    p = get_reasoning_parser("qwen3")
+    c, r = p.parse_full("plain text, no thinking")
+    assert c == "plain text, no thinking" and r == ""
+
+
+def test_reasoning_kimi_unicode_tags():
+    p = get_reasoning_parser("kimi-k1.5")
+    c, r = p.parse_full("◁think▷deep◁/think▷out")
+    assert r == "deep" and c == "out"
+
+
+def test_reasoning_unknown_model_passthrough():
+    p = get_reasoning_parser("some-unknown-model")
+    c, r = p.parse_full("<think>x</think>y")
+    assert c == "<think>x</think>y" and r == ""
+
+
+# ---- partial json ----
+
+def test_complete_json_closes_scopes():
+    assert json.loads(complete_json('{"a": [1, 2')) == {"a": [1, 2]}
+    assert json.loads(complete_json('{"a": "uncl')) == {"a": "uncl"}
+    assert complete_json('}{') is None
+
+
+def test_parse_partial_trailing_key():
+    assert parse_partial('{"name": "f", "arguments": {"x":') == {"name": "f"} or \
+        parse_partial('{"name": "f", "arguments": {"x":') == {"name": "f", "arguments": {}}
+
+
+# ---- tool calls ----
+
+def tool_stream(parser, text, n=4):
+    normal = ""
+    calls = []
+    for i in range(0, len(text), n):
+        d = parser.feed(text[i : i + n])
+        normal += d.normal_text
+        calls.extend(d.calls)
+    d = parser.flush()
+    return normal + d.normal_text, calls + d.calls
+
+
+def test_json_tool_parser():
+    p = get_tool_parser("json")
+    text = 'Sure thing {"name": "get_weather", "arguments": {"city": "Paris"}} done'
+    normal, calls = p.parse_full(text)
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+    assert "Sure thing" in normal and "done" in normal
+
+
+def test_json_tool_array():
+    p = get_tool_parser("json")
+    _, calls = p.parse_full('[{"name": "a", "arguments": {}}, {"name": "b", "arguments": {"x": 1}}]')
+    assert [c.name for c in calls] == ["a", "b"]
+    assert calls[1].index == 1
+
+
+def test_qwen_tool_parser_streaming():
+    p = get_tool_parser("qwen2.5-72b")
+    text = 'before <tool_call>\n{"name": "search", "arguments": {"q": "jax"}}\n</tool_call> after'
+    for n in (3, 5, 100):
+        p = get_tool_parser("qwen")
+        normal, calls = tool_stream(p, text, n=n)
+        assert len(calls) == 1, f"chunk={n}"
+        assert calls[0].name == "search"
+        assert json.loads(calls[0].arguments) == {"q": "jax"}
+        assert "before" in normal and "after" in normal
+        assert "<tool_call>" not in normal
+
+
+def test_mistral_tool_parser():
+    p = get_tool_parser("mistral-large")
+    _, calls = p.parse_full('[TOOL_CALLS] [{"name": "f", "arguments": {"a": 1}}]')
+    assert calls[0].name == "f"
+    assert json.loads(calls[0].arguments) == {"a": 1}
+
+
+def test_llama3_python_tag():
+    p = get_tool_parser("llama-3.1-8b-instruct")
+    _, calls = p.parse_full('<|python_tag|>{"name": "calc", "parameters": {"expr": "2+2"}}')
+    assert calls[0].name == "calc"
+    assert json.loads(calls[0].arguments) == {"expr": "2+2"}
+
+
+def test_deepseek_tool_parser():
+    p = get_tool_parser("deepseek-v3")
+    text = (
+        "<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>function<｜tool▁sep｜>get_time\n"
+        '```json\n{"tz": "UTC"}\n```<｜tool▁call▁end｜><｜tool▁calls▁end｜>'
+    )
+    _, calls = p.parse_full(text)
+    assert calls[0].name == "get_time"
+    assert json.loads(calls[0].arguments) == {"tz": "UTC"}
+
+
+def test_kimi_k2_tool_parser():
+    p = get_tool_parser("kimi-k2")
+    text = (
+        "<|tool_calls_section_begin|><|tool_call_begin|>functions.ls:0"
+        '<|tool_call_argument_begin|>{"path": "/tmp"}<|tool_call_end|>'
+        "<|tool_calls_section_end|>"
+    )
+    _, calls = p.parse_full(text)
+    assert calls[0].name == "ls"
+    assert json.loads(calls[0].arguments) == {"path": "/tmp"}
+
+
+def test_glm4_moe_tool_parser():
+    p = get_tool_parser("glm-4.5")
+    text = (
+        "<tool_call>get_weather\n"
+        "<arg_key>city</arg_key>\n<arg_value>\"Beijing\"</arg_value>\n"
+        "<arg_key>days</arg_key>\n<arg_value>3</arg_value>\n"
+        "</tool_call>"
+    )
+    _, calls = p.parse_full(text)
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Beijing", "days": 3}
+
+
+def test_pythonic_tool_parser():
+    p = get_tool_parser("llama-4-scout")
+    _, calls = p.parse_full('[get_weather(city="Paris", days=2), search(q="news")]')
+    assert [c.name for c in calls] == ["get_weather", "search"]
+    assert json.loads(calls[0].arguments) == {"city": "Paris", "days": 2}
+
+
+def test_plain_text_not_mistaken_for_calls():
+    for model in ("json", "qwen", "mistral", "llama"):
+        p = get_tool_parser(model)
+        normal, calls = p.parse_full("just plain prose with no tools at all")
+        assert calls == []
+        assert "plain prose" in normal
+
+
+def test_json_like_text_without_name_is_text():
+    p = get_tool_parser("json")
+    normal, calls = p.parse_full('the object {"key": "value"} is not a call')
+    assert calls == []
+    assert '{"key": "value"}' in normal
