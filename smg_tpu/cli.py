@@ -36,10 +36,16 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--port", type=int, default=30000)
     g.add_argument("--worker", action="append", default=[], dest="workers",
                    help="worker URL (repeatable)")
+    g.add_argument("--prefill-worker", action="append", default=[], dest="prefill_workers",
+                   help="prefill-role worker URL (PD disaggregation; repeatable)")
+    g.add_argument("--decode-worker", action="append", default=[], dest="decode_workers",
+                   help="decode-role worker URL (PD disaggregation; repeatable)")
     g.add_argument("--policy", default="cache_aware",
                    help="routing policy (round_robin, random, cache_aware, least_load, "
                         "power_of_two, prefix_hash, consistent_hashing, manual, bucket)")
     g.add_argument("--max-concurrent-requests", type=int, default=256)
+    g.add_argument("--gateway-tokenizer-path", default=None, dest="gateway_tokenizer_path",
+                   help="tokenizer for gateway-side text processing (launch mode)")
     g.add_argument("--log-level", default="INFO")
     g.add_argument("--prometheus-port", type=int, default=None)
 

@@ -115,6 +115,72 @@ class Engine:
         with self._lock:
             return self.runner.embed(batches)
 
+    # ---- PD disaggregation legs ----
+
+    def prefill_export(self, prompt_ids: list[int], sampling: SamplingParams) -> dict:
+        """Prefill leg: compute the prompt's KV, export pages to host, free
+        them.  Returns {first_token, k, v, seq_len} (k/v: [L, n, ps, KD])."""
+        with self._lock:
+            tok, pages, seq_len = self.scheduler.prefill_only(prompt_ids, sampling)
+            k, v = self.runner.export_pages(pages)
+            self.scheduler.release_pages(pages)
+        return {"first_token": tok, "k": k, "v": v, "seq_len": seq_len}
+
+    def submit_prefilled(
+        self,
+        prompt_ids: list[int],
+        first_token: int,
+        k,  # np [L, n_pages, ps, KD]
+        v,
+        sampling: SamplingParams,
+        rid: str | None = None,
+        on_output=None,
+    ) -> str:
+        """Decode leg: import prompt KV, adopt the request, continue decoding.
+        Falls back to a normal (re-prefilling) submission when no slot/pages
+        are available."""
+        rid = rid or f"req-{uuid.uuid4().hex[:16]}"
+        req = EngineRequest(rid=rid, prompt_ids=list(prompt_ids), sampling=sampling)
+        if self.tokenizer is not None:
+            req.detok = IncrementalDecoder(
+                self.tokenizer, skip_special_tokens=sampling.skip_special_tokens
+            )
+            if sampling.stop:
+                req.stop_checker = StopStringChecker(sampling.stop)
+        with self._wakeup:
+            pages = None
+            try:
+                pages = self.scheduler.alloc_import_pages(len(prompt_ids))
+                self.runner.import_pages(pages, k, v)
+                adopted = self.scheduler.adopt_prefilled(req, pages, first_token)
+            except Exception:
+                logger.exception("KV import failed for %s", rid)
+                adopted = False
+            if not adopted and pages is not None:
+                self.scheduler.release_pages(pages)
+            if on_output is not None:
+                self._callbacks[rid] = on_output
+            if adopted:
+                step_outs: list = []
+                self.scheduler._accept_tokens(
+                    req, [int(first_token)], [0.0], step_outs, advance_seq=False
+                )
+                outputs = [self._postprocess(so) for so in step_outs]
+            else:
+                # degraded path: re-prefill locally (keeps the request alive
+                # under slot/page pressure)
+                logger.warning("PD adopt failed for %s; falling back to local prefill", rid)
+                self.scheduler.add_request(req)
+                outputs = []
+            self._wakeup.notify_all()
+        for out in outputs:
+            cb = self._callbacks.get(out.rid)
+            if cb is not None:
+                cb(out)
+                if out.finished:
+                    self._callbacks.pop(out.rid, None)
+        return rid
+
     # ---- stepping ----
 
     def step(self) -> list[RequestOutput]:

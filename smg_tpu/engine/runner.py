@@ -453,6 +453,25 @@ class ModelRunner:
         )
         return np.asarray(toks), np.asarray(lps)
 
+    def export_pages(self, pages: "list[int]") -> tuple[np.ndarray, np.ndarray]:
+        """Fetch KV pages to host: ([L, n, ps, KD] k, v).
+
+        PD disaggregation fallback path (host-mediated).  On multi-chip
+        deployments the production path moves pages device-to-device over
+        ICI/DCN (jax device transfer) — this host round trip is the portable
+        seam the connector abstraction plugs into (reference analogue:
+        NIXL/Mooncake connectors, request_execution.rs:38-82)."""
+        idx = jnp.asarray(pages, jnp.int32)
+        k = np.asarray(self.k_cache[:, idx])
+        v = np.asarray(self.v_cache[:, idx])
+        return k, v
+
+    def import_pages(self, pages: "list[int]", k: np.ndarray, v: np.ndarray) -> None:
+        """Scatter host KV pages into the device cache at ``pages``."""
+        idx = jnp.asarray(pages, jnp.int32)
+        self.k_cache = self.k_cache.at[:, idx].set(jnp.asarray(k, self.k_cache.dtype))
+        self.v_cache = self.v_cache.at[:, idx].set(jnp.asarray(v, self.v_cache.dtype))
+
     def embed(self, batches: "list[list[int]]") -> np.ndarray:
         """Sequence embeddings for a batch of token-id lists: [n, hidden]."""
         n = len(batches)

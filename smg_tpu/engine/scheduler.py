@@ -381,6 +381,64 @@ class Scheduler:
             self._release(req, finish)
         outputs.append(StepOutput(req, accepted, finish is not None, finish))
 
+    # ---- PD disaggregation (SURVEY.md §2.5: PrefillDecode routing mode) ----
+
+    def prefill_only(self, prompt_ids: list[int], sampling) -> tuple[int, list[int], int]:
+        """Prefill a prompt and keep its pages allocated (no decode slot).
+        Returns (first_token, pages, seq_len).  Caller must ``release_pages``.
+        Used by the prefill leg of PD disaggregation."""
+        n_pages = math.ceil(len(prompt_ids) / self.ps)
+        if not self._ensure_free_pages(n_pages):
+            raise RuntimeError("out of KV pages for prefill-only request")
+        pages = self.pool.alloc(n_pages)
+        row = np.zeros(self.mp, np.int32)
+        row[: len(pages)] = pages
+        start = 0
+        tok = None
+        while start < len(prompt_ids):
+            chunk = prompt_ids[start : start + self.sched.max_prefill_tokens]
+            tok, _ = self.runner.prefill(
+                chunk, prefix_len=start, page_table=row,
+                temperature=sampling.temperature, top_k=sampling.top_k,
+                top_p=sampling.top_p, min_p=sampling.min_p,
+            )
+            self.num_prefill_tokens += len(chunk)
+            start += len(chunk)
+        return tok, pages, len(prompt_ids)
+
+    def release_pages(self, pages: list[int]) -> None:
+        self.pool.free(pages)
+
+    def adopt_prefilled(
+        self, req: EngineRequest, pages: list[int], first_token: int
+    ) -> bool:
+        """Adopt a request whose prompt KV was imported (decode leg of PD).
+        Pages become owned by the request; returns False when no slot free."""
+        free_slots = [i for i, s in enumerate(self.slots) if s is None]
+        if not free_slots:
+            return False
+        if req.rid in self.requests:
+            raise ValueError(f"duplicate request id {req.rid}")
+        self.requests[req.rid] = req
+        req.owned_pages = list(pages)
+        req.seq_len = req.prompt_len
+        req.status = RequestStatus.RUNNING
+        slot = free_slots[0]
+        req.slot = slot
+        row = self.page_tables[slot]
+        row[:] = 0
+        row[: len(pages)] = pages
+        self.slots[slot] = req
+        # first_token is accepted by the caller (stop checks + client emission)
+        del first_token
+        return True
+
+    def alloc_import_pages(self, n_tokens: int) -> list[int]:
+        n_pages = math.ceil(n_tokens / self.ps)
+        if not self._ensure_free_pages(n_pages):
+            raise RuntimeError("out of KV pages for import")
+        return self.pool.alloc(n_pages)
+
     def finish_request(self, rid: str, reason: str, matched_stop=None) -> None:
         """External finish (e.g. the engine found a stop string)."""
         req = self.requests.get(rid)

@@ -53,15 +53,21 @@ def verify_hs256(token: str, secret: str) -> dict:
         header_b64, payload_b64, sig_b64 = token.split(".")
     except ValueError:
         raise AuthError("malformed token")
-    header = json.loads(_b64url_decode(header_b64))
-    if header.get("alg") != "HS256":
-        raise AuthError(f"unsupported alg {header.get('alg')}")
-    expected = hmac.new(
-        secret.encode(), f"{header_b64}.{payload_b64}".encode(), hashlib.sha256
-    ).digest()
-    if not hmac.compare_digest(expected, _b64url_decode(sig_b64)):
-        raise AuthError("bad signature")
-    payload = json.loads(_b64url_decode(payload_b64))
+    try:
+        header = json.loads(_b64url_decode(header_b64))
+        if header.get("alg") != "HS256":
+            raise AuthError(f"unsupported alg {header.get('alg')}")
+        expected = hmac.new(
+            secret.encode(), f"{header_b64}.{payload_b64}".encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(expected, _b64url_decode(sig_b64)):
+            raise AuthError("bad signature")
+        payload = json.loads(_b64url_decode(payload_b64))
+    except AuthError:
+        raise
+    except Exception:
+        # malformed base64/JSON anywhere in the token is a credential error
+        raise AuthError("malformed token")
     if "exp" in payload and payload["exp"] < time.time():
         raise AuthError("token expired")
     return payload

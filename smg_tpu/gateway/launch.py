@@ -114,7 +114,22 @@ async def _run_gateway(args) -> int:
                 page_size=engine.config.cache.page_size,
             )
         )
-    for url in getattr(args, "workers", []):
+    if args.command == "launch":
+        # gateway-only mode still does gateway-side tokenize/detokenize
+        tokenizer = load_tokenizer(
+            getattr(args, "gateway_tokenizer_path", None)
+            or getattr(args, "tokenizer_path", None)
+        )
+        ctx.tokenizers.register("default", tokenizer, default=True)
+
+    from smg_tpu.gateway.workers import WorkerType
+
+    role_urls = (
+        [(u, WorkerType.REGULAR) for u in getattr(args, "workers", [])]
+        + [(u, WorkerType.PREFILL) for u in getattr(args, "prefill_workers", [])]
+        + [(u, WorkerType.DECODE) for u in getattr(args, "decode_workers", [])]
+    )
+    for url, wtype in role_urls:
         from smg_tpu.rpc.client import GrpcWorkerClient
 
         client = GrpcWorkerClient(url)
@@ -122,7 +137,7 @@ async def _run_gateway(args) -> int:
         ctx.registry.add(
             Worker(
                 worker_id=url, client=client, model_id=info.get("model_id", "default"),
-                url=url, page_size=info.get("page_size") or None,
+                url=url, page_size=info.get("page_size") or None, worker_type=wtype,
             )
         )
 

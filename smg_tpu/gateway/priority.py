@@ -85,6 +85,15 @@ class PriorityScheduler:
                     self._queues[priority].remove(fut)
             self.stats[priority]["rejected"] += 1
             raise AdmissionRejected(f"{priority} admission timed out after {timeout}s")
+        except asyncio.CancelledError:
+            # the waiter may have been handed a slot between set_result and
+            # this cancellation — return it so the slot isn't leaked
+            async with self._lock:
+                if fut in self._queues[priority]:
+                    self._queues[priority].remove(fut)
+            if fut.done() and not fut.cancelled():
+                self._release()
+            raise
         self.stats[priority]["admitted"] += 1
         return SlotGuard(self)
 

@@ -49,11 +49,16 @@ class TokenBucket:
 
 class RateLimiter:
     """Per-tenant buckets with a default config; tenant id comes from auth or
-    the X-Tenant-Id header (reference: tenant_resolution middleware)."""
+    the X-Tenant-Id header (reference: tenant_resolution middleware).
+
+    Two independent limits per tenant: a token bucket (burst + sustained rate
+    when ``refill_per_sec`` > 0) and a hard in-flight cap (``max_concurrent``)
+    enforced regardless of refill mode."""
 
     def __init__(self, default: RateLimitConfig | None = None):
         self.default = default or RateLimitConfig()
         self._buckets: dict[str, TokenBucket] = {}
+        self._inflight: dict[str, int] = {}
         self._overrides: dict[str, RateLimitConfig] = {}
         self._lock = threading.Lock()
 
@@ -62,17 +67,29 @@ class RateLimiter:
             self._overrides[tenant] = config
             self._buckets.pop(tenant, None)
 
+    def _cfg(self, tenant: str) -> RateLimitConfig:
+        return self._overrides.get(tenant, self.default)
+
     def _bucket(self, tenant: str) -> TokenBucket:
         with self._lock:
             b = self._buckets.get(tenant)
             if b is None:
-                cfg = self._overrides.get(tenant, self.default)
+                cfg = self._cfg(tenant)
                 b = TokenBucket(cfg.capacity, cfg.refill_per_sec)
                 self._buckets[tenant] = b
             return b
 
     def try_acquire(self, tenant: str = "default", cost: float = 1.0) -> bool:
-        return self._bucket(tenant).try_acquire(cost)
+        with self._lock:
+            if self._inflight.get(tenant, 0) >= self._cfg(tenant).max_concurrent:
+                return False
+        if not self._bucket(tenant).try_acquire(cost):
+            return False
+        with self._lock:
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+        return True
 
     def release(self, tenant: str = "default", amount: float = 1.0) -> None:
+        with self._lock:
+            self._inflight[tenant] = max(0, self._inflight.get(tenant, 0) - 1)
         self._bucket(tenant).release(amount)

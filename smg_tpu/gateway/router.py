@@ -110,6 +110,16 @@ class Router:
             raise RouteError(503, "no healthy workers available", "service_unavailable")
         return worker
 
+    def _pd_pools(self, model_id: str | None):
+        """(prefill_pool, decode_pool) — non-empty pair means PD mode
+        (reference: RoutingMode::PrefillDecode, worker_selection.rs:28-36)."""
+        from smg_tpu.gateway.workers import WorkerType
+
+        candidates = self._candidate_workers(model_id)
+        prefill = [w for w in candidates if w.worker_type == WorkerType.PREFILL]
+        decode = [w for w in candidates if w.worker_type == WorkerType.DECODE]
+        return prefill, decode
+
     # ---- core execution with retry (stages 3-6) ----
 
     async def _execute(
@@ -129,6 +139,15 @@ class Router:
             if tokenizer is not None
             else None
         )
+
+        prefill_pool, decode_pool = self._pd_pools(ctx.model_id)
+        if prefill_pool and decode_pool:
+            async for ev in self._execute_pd(
+                ctx, input_ids, worker_sampling, rid, detok, stop_checker,
+                prefill_pool, decode_pool,
+            ):
+                yield ev
+            return
 
         attempts = 0
         exclude: set[str] = set()
@@ -189,6 +208,66 @@ class Router:
             finally:
                 if not finished_cleanly:
                     guard.release(success=True)  # no-op if already released
+
+    async def _execute_pd(
+        self, ctx, input_ids, worker_sampling, rid, detok, stop_checker,
+        prefill_pool, decode_pool,
+    ):
+        """PD-disaggregated execution: prefill leg computes + exports the
+        prompt KV; decode leg imports it and streams tokens (reference:
+        dual-dispatch in request_execution.rs:34-82; KV rides the connector
+        seam — host-mediated here, ICI/DCN on multi-chip deployments)."""
+        policy = self.policies.policy_for(ctx.model_id)
+        p_worker = policy.select_worker(prefill_pool, ctx)
+        if p_worker is None:
+            raise RouteError(503, "no healthy prefill workers", "service_unavailable")
+        p_guard = p_worker.acquire()
+        try:
+            export = await p_worker.client.prefill_export(input_ids, worker_sampling)
+            p_guard.release(success=True)
+        except Exception as e:
+            p_guard.release(success=False)
+            raise RouteError(502, f"prefill worker error: {e}", "worker_error")
+
+        d_worker = policy.select_worker(decode_pool, ctx)
+        if d_worker is None:
+            raise RouteError(503, "no healthy decode workers", "service_unavailable")
+        d_guard = d_worker.acquire()
+        finished_cleanly = False
+        try:
+            wreq = WorkerGenerateRequest(rid=rid, input_ids=input_ids, sampling=worker_sampling)
+            async for chunk in d_worker.client.generate_prefilled(
+                wreq, export["first_token"], export["k"], export["v"]
+            ):
+                ev = self._chunk_to_event(chunk, detok, stop_checker)
+                if ev is not None:
+                    yield ev
+                    if ev.finished and not chunk.finished:
+                        await d_worker.client.abort(rid)
+                        finished_cleanly = True
+                        d_guard.release(success=True)
+                        return
+                if chunk.finished:
+                    finished_cleanly = True
+                    d_guard.release(success=True)
+                    return
+            raise RuntimeError("decode stream ended unexpectedly")
+        except (GeneratorExit, asyncio.CancelledError):
+            d_guard.release(success=True)
+            try:
+                await asyncio.shield(d_worker.client.abort(rid))
+            except Exception:
+                pass
+            raise
+        except RouteError:
+            d_guard.release(success=False)
+            raise
+        except Exception as e:
+            d_guard.release(success=False)
+            raise RouteError(502, f"decode worker error: {e}", "worker_error")
+        finally:
+            if not finished_cleanly:
+                d_guard.release(success=True)
 
     def _chunk_to_event(
         self,
@@ -298,8 +377,12 @@ class Router:
             return choice, last
 
         # TaskGroup cancels siblings on first failure (n>1 fan-out)
-        async with asyncio.TaskGroup() as tg:
-            tasks = [tg.create_task(run_one(i)) for i in range(sampling.n)]
+        try:
+            async with asyncio.TaskGroup() as tg:
+                tasks = [tg.create_task(run_one(i)) for i in range(sampling.n)]
+        except BaseExceptionGroup as eg:
+            route = next((e for e in eg.exceptions if isinstance(e, RouteError)), None)
+            raise route if route is not None else eg.exceptions[0]
         results = [t.result() for t in tasks]
         choices = [c for c, _ in results]
         usage = UsageInfo(

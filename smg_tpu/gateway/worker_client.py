@@ -55,6 +55,14 @@ class WorkerClient:
         """batches: list[list[int]] -> list[list[float]]."""
         raise NotImplementedError
 
+    async def prefill_export(self, input_ids: list, sampling) -> dict:
+        """PD prefill leg: {first_token, k, v, seq_len} (k/v numpy)."""
+        raise NotImplementedError
+
+    def generate_prefilled(self, req, first_token: int, k, v):
+        """PD decode leg: async iterator of WorkerStreamChunk."""
+        raise NotImplementedError
+
     async def health(self) -> bool:
         raise NotImplementedError
 
@@ -119,6 +127,43 @@ class InProcWorkerClient(WorkerClient):
             None, self.engine.embed, [list(b) for b in batches]
         )
         return [v.tolist() for v in vecs]
+
+    async def prefill_export(self, input_ids: list, sampling) -> dict:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self.engine.prefill_export, list(input_ids), sampling
+        )
+
+    async def generate_prefilled(self, req, first_token: int, k, v):
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_output(out) -> None:  # engine thread
+            chunk = WorkerStreamChunk(
+                rid=req.rid,
+                token_ids=list(out.new_token_ids),
+                logprobs=list(out.logprobs),
+                finished=out.finished,
+                finish_reason=out.finish_reason,
+                matched_stop=out.matched_stop,
+                prompt_tokens=out.prompt_tokens,
+                cached_tokens=out.cached_tokens,
+                output_tokens=out.output_tokens,
+            )
+            loop.call_soon_threadsafe(q.put_nowait, chunk)
+
+        await loop.run_in_executor(
+            None,
+            lambda: self.engine.submit_prefilled(
+                req.input_ids, first_token, k, v, req.sampling,
+                rid=req.rid, on_output=on_output,
+            ),
+        )
+        while True:
+            chunk = await q.get()
+            yield chunk
+            if chunk.finished:
+                return
 
     async def health(self) -> bool:
         return True

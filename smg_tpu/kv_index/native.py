@@ -1,0 +1,148 @@
+"""ctypes binding for the native C++ radix index (csrc/radix_index.cpp).
+
+Reference analogue: the Rust ``crates/kv_index`` backing the gateway's
+routing hot path.  Auto-builds ``libsmg_native.so`` on first use (make in
+csrc/); falls back to the pure-Python ``RadixTree`` when no toolchain is
+available.  Same interface as the Python tree so the cache_aware policy can
+swap implementations (``SMG_NATIVE_RADIX=0`` forces Python).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("kv_index.native")
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libsmg_native.so"))
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if os.environ.get("SMG_NATIVE_RADIX") == "0":
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_CSRC)],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception as e:
+                logger.warning("native radix build failed (%s); using Python tree", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native radix load failed (%s); using Python tree", e)
+            return None
+        lib.rt_new.restype = ctypes.c_void_p
+        lib.rt_new.argtypes = [ctypes.c_size_t]
+        lib.rt_free.argtypes = [ctypes.c_void_p]
+        lib.rt_insert.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.c_uint32,
+        ]
+        lib.rt_match.restype = ctypes.c_size_t
+        lib.rt_match.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t,
+        ]
+        lib.rt_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.rt_size.restype = ctypes.c_size_t
+        lib.rt_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        logger.info("native radix index loaded (%s)", _LIB_PATH)
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class NativeRadixTree:
+    """Same interface as ``smg_tpu.kv_index.RadixTree`` — str/token sequences
+    in, per-worker matched lengths out — backed by the C++ tree."""
+
+    MAX_WORKERS = 1024
+
+    def __init__(self, max_size: int = 2**20):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native radix library unavailable")
+        self._lib = lib
+        self._tree = lib.rt_new(max_size)
+        self._worker_ids: dict[str, int] = {}
+        self._worker_names: dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        tree = getattr(self, "_tree", None)
+        if tree:
+            self._lib.rt_free(tree)
+            self._tree = None
+
+    def _wid(self, worker: str) -> int:
+        with self._lock:
+            wid = self._worker_ids.get(worker)
+            if wid is None:
+                wid = len(self._worker_ids) + 1
+                self._worker_ids[worker] = wid
+                self._worker_names[wid] = worker
+            return wid
+
+    @staticmethod
+    def _encode(seq) -> "ctypes.Array":
+        if isinstance(seq, str):
+            vals = [ord(c) for c in seq]
+        else:
+            vals = [int(t) for t in seq]
+        return (ctypes.c_uint32 * len(vals))(*vals), len(vals)
+
+    def insert(self, seq, worker_id: str) -> None:
+        buf, n = self._encode(seq)
+        self._lib.rt_insert(self._tree, buf, n, self._wid(worker_id))
+
+    def prefix_match(self, seq) -> dict[str, int]:
+        buf, n = self._encode(seq)
+        out_w = (ctypes.c_uint32 * self.MAX_WORKERS)()
+        out_l = (ctypes.c_uint32 * self.MAX_WORKERS)()
+        count = self._lib.rt_match(self._tree, buf, n, out_w, out_l, self.MAX_WORKERS)
+        result = {}
+        for i in range(count):
+            name = self._worker_names.get(out_w[i])
+            if name is not None:
+                result[name] = out_l[i]
+        return result
+
+    def remove_worker(self, worker_id: str) -> None:
+        with self._lock:
+            wid = self._worker_ids.get(worker_id)
+        if wid is not None:
+            self._lib.rt_remove_worker(self._tree, wid)
+
+    @property
+    def size(self) -> int:
+        return self._lib.rt_size(self._tree)
+
+
+def make_radix_tree(max_size: int = 2**20):
+    """Factory: native tree when available, Python tree otherwise."""
+    if native_available():
+        try:
+            return NativeRadixTree(max_size)
+        except RuntimeError:
+            pass
+    from smg_tpu.kv_index.radix_tree import RadixTree
+
+    return RadixTree(max_size)

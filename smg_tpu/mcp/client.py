@@ -144,16 +144,20 @@ class HttpMcpServer(McpToolServer):
 
 
 class McpRegistry:
-    """Named MCP servers; flat tool namespace with collision-aware lookup."""
+    """Named MCP servers; flat tool namespace with a cached name->server map
+    (refreshed on registry change or lookup miss, not per call)."""
 
     def __init__(self):
         self._servers: dict[str, McpToolServer] = {}
+        self._tool_map: dict[str, str] | None = None  # tool name -> server name
 
     def add(self, server: McpToolServer) -> None:
         self._servers[server.name] = server
+        self._tool_map = None
 
     def remove(self, name: str) -> None:
         self._servers.pop(name, None)
+        self._tool_map = None
 
     @property
     def servers(self) -> list[str]:
@@ -161,24 +165,26 @@ class McpRegistry:
 
     async def list_tools(self) -> list[ToolInfo]:
         out: list[ToolInfo] = []
+        tool_map: dict[str, str] = {}
         for s in self._servers.values():
             try:
-                out.extend(await s.list_tools())
+                tools = await s.list_tools()
             except Exception:
                 logger.exception("tools/list failed for MCP server %s", s.name)
+                continue
+            for t in tools:
+                tool_map.setdefault(t.name, s.name)
+            out.extend(tools)
+        self._tool_map = tool_map
         return out
 
     async def call_tool(self, name: str, arguments: dict) -> str:
-        last_err: Exception | None = None
-        for s in self._servers.values():
-            try:
-                tools = {t.name for t in await s.list_tools()}
-            except Exception as e:
-                last_err = e
-                continue
-            if name in tools:
-                return await s.call_tool(name, arguments)
-        raise KeyError(f"tool {name!r} not found in any MCP server") from last_err
+        if self._tool_map is None or name not in self._tool_map:
+            await self.list_tools()  # refresh once on miss / first use
+        server_name = (self._tool_map or {}).get(name)
+        if server_name is None or server_name not in self._servers:
+            raise KeyError(f"tool {name!r} not found in any MCP server")
+        return await self._servers[server_name].call_tool(name, arguments)
 
     async def close(self) -> None:
         for s in self._servers.values():

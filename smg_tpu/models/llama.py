@@ -412,11 +412,14 @@ def forward_train(
     cfg: ModelConfig,
     inv_freq: jnp.ndarray,
     tokens: jnp.ndarray,  # [B, T]
+    ring_mesh=None,  # Mesh with an "sp" axis: use ring attention (seq parallel)
 ) -> jnp.ndarray:
     """Dense causal forward for training / eval-logprobs: logits [B, T, V].
 
-    No KV cache; plain causal attention.  Used by the training utilities and
-    the multi-chip dry-run (full dp x tp x sp sharded step).
+    No KV cache.  With ``ring_mesh`` the attention runs as blockwise ring
+    attention over the ``sp`` axis (``smg_tpu/parallel/ring_attention.py``) —
+    KV shards rotate over ICI instead of the all-gather GSPMD would insert,
+    which is what makes million-token-class sequence parallelism viable.
     """
     B, T = tokens.shape
     scale = 1.0 / math.sqrt(cfg.head_dim)
@@ -432,12 +435,17 @@ def forward_train(
         k = apply_rope(k, pos, inv_freq)
         K = cfg.num_kv_heads
         G = cfg.num_heads // K
-        qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
-        scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
-        scores = jnp.where(causal[None, None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
-        attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
+        if ring_mesh is not None:
+            from smg_tpu.parallel.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, ring_mesh, scale)
+        else:
+            qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
+            scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
+            scores = jnp.where(causal[None, None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+            attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
         h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn)

@@ -1,0 +1,99 @@
+"""Ring attention: sequence-parallel causal attention over the ``sp`` mesh axis.
+
+The reference has NO sequence/context parallelism anywhere (SURVEY.md §2.5 —
+engines' concern); the TPU build owns it.  Design: blockwise ring attention
+(Liu et al.) — each device holds a Q/K/V sequence shard; KV shards rotate
+around the ring via ``lax.ppermute`` while each device accumulates its Q
+shard's online-softmax statistics.  Communication rides ICI neighbor links
+(bandwidth-optimal: each step moves one KV shard per device, overlapping with
+the local attention block), instead of the all-gather GSPMD would insert.
+
+Causality with sharded sequences: device d owns global query positions
+[d*T_loc, (d+1)*T_loc); the KV block visiting at ring step i originated at
+device (d - i) mod n, so masks derive from (device, step) offsets — blocks
+entirely in the future are skipped-by-mask, the diagonal block is triangular,
+and past blocks are unmasked.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,  # [B, T_loc, H, D] this device's query shard (post-rope)
+    k: jnp.ndarray,  # [B, T_loc, K, D] this device's KV shard
+    v: jnp.ndarray,
+    scale: float,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Body run per-device under shard_map."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, T_loc, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+
+    qf = q.astype(jnp.float32).reshape(B, T_loc, K, G, D)
+    q_pos = my_idx * T_loc + jnp.arange(T_loc)  # global query positions
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my_idx - i) % n  # device the visiting KV block came from
+        k_pos = src * T_loc + jnp.arange(T_loc)
+
+        scores = jnp.einsum(
+            "btkgd,bskd->btkgs", qf, k_cur.astype(jnp.float32)
+        ) * scale  # [B, T_loc, K, G, T_loc]
+        mask = q_pos[:, None] >= k_pos[None, :]  # [T_loc, T_loc]
+        scores = jnp.where(mask[None, :, None, None, :], scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("btkgs,bskd->btkgd", p, v_cur.astype(jnp.float32))
+        acc_new = acc * alpha + pv
+
+        # rotate KV to the next device (ring over ICI)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, T_loc, K, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, T_loc, K, G, 1), jnp.float32)
+    acc0 = jnp.zeros((B, T_loc, K, G, D), jnp.float32)
+    (k, v, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.reshape(B, T_loc, H, D).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, T, H, D] GLOBAL arrays, T sharded on axis_name
+    k: jnp.ndarray,  # [B, T, K, D]
+    v: jnp.ndarray,
+    mesh: Mesh,
+    scale: float,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Causal ring attention with the sequence dim sharded over ``axis_name``.
+    Other mesh axes pass through (batch may be dp-sharded etc.)."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, scale=scale, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

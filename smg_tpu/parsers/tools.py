@@ -267,7 +267,7 @@ class Llama3ToolParser(JsonToolParser):
             return calls, rest, True
         val = parse_partial(buf)
         if val is not None and isinstance(val, dict) and ("name" in val or not val):
-            return [], "<|python_tag|>" + buf if False else buf, False
+            return [], buf, False
         return [], buf, True
 
 

@@ -43,7 +43,10 @@ class CacheAwarePolicy(Policy):
         self.match_threshold = match_threshold
         self.imbalance_abs = imbalance_abs
         self.imbalance_rel = imbalance_rel
-        self.tree = RadixTree(max_size=max_tree_size)
+        # native C++ tree when the toolchain built it; Python tree otherwise
+        from smg_tpu.kv_index.native import make_radix_tree
+
+        self.tree = make_radix_tree(max_tree_size)
         self.indexer = PositionalIndexer(page_size=page_size)
         self._rng = _random.Random(seed)
 

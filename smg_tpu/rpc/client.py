@@ -33,8 +33,8 @@ class GrpcWorkerClient(WorkerClient):
         self._channel = grpc.aio.insecure_channel(
             url,
             options=[
-                ("grpc.max_send_message_length", 64 * 1024 * 1024),
-                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                ("grpc.max_send_message_length", 512 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 512 * 1024 * 1024),
                 ("grpc.keepalive_time_ms", 30000),
             ],
         )
@@ -53,6 +53,16 @@ class GrpcWorkerClient(WorkerClient):
             method("EmbedBatch"),
             request_serializer=pb.EmbedBatchRequestProto.SerializeToString,
             response_deserializer=pb.EmbedBatchResponseProto.FromString,
+        )
+        self._prefill_export = c.unary_unary(
+            method("PrefillExport"),
+            request_serializer=pb.PrefillExportRequestProto.SerializeToString,
+            response_deserializer=pb.PrefillExportResponseProto.FromString,
+        )
+        self._generate_prefilled = c.unary_stream(
+            method("GeneratePrefilled"),
+            request_serializer=pb.GeneratePrefilledRequestProto.SerializeToString,
+            response_deserializer=pb.GenerateChunk.FromString,
         )
         self._abort = c.unary_unary(
             method("Abort"),
@@ -91,6 +101,56 @@ class GrpcWorkerClient(WorkerClient):
             rid=req.rid, input_ids=req.input_ids, sampling=sampling_to_proto(req.sampling)
         )
         call = self._generate(msg)
+        try:
+            async for chunk in call:
+                if chunk.error:
+                    raise RuntimeError(f"worker error: {chunk.error}")
+                yield WorkerStreamChunk(
+                    rid=chunk.rid,
+                    token_ids=list(chunk.token_ids),
+                    logprobs=list(chunk.logprobs),
+                    finished=chunk.finished,
+                    finish_reason=chunk.finish_reason or None,
+                    matched_stop=(
+                        chunk.matched_stop_token if chunk.matched_stop_token >= 0 else None
+                    ),
+                    prompt_tokens=chunk.prompt_tokens,
+                    cached_tokens=chunk.cached_tokens,
+                    output_tokens=chunk.output_tokens,
+                )
+        finally:
+            call.cancel()
+
+    async def prefill_export(self, input_ids: list, sampling) -> dict:
+        import numpy as np
+
+        resp = await self._prefill_export(
+            pb.PrefillExportRequestProto(
+                rid="prefill", input_ids=input_ids, sampling=sampling_to_proto(sampling)
+            ),
+            timeout=600,
+        )
+        if resp.error:
+            raise RuntimeError(f"prefill export error: {resp.error}")
+        shape = tuple(resp.kv_shape)
+        return {
+            "first_token": resp.first_token,
+            "seq_len": resp.seq_len,
+            "k": np.frombuffer(resp.k, dtype=resp.kv_dtype).reshape(shape),
+            "v": np.frombuffer(resp.v, dtype=resp.kv_dtype).reshape(shape),
+        }
+
+    async def generate_prefilled(self, req, first_token: int, k, v):
+        msg = pb.GeneratePrefilledRequestProto(
+            base=pb.GenerateRequestProto(
+                rid=req.rid, input_ids=req.input_ids,
+                sampling=sampling_to_proto(req.sampling),
+            ),
+            first_token=first_token,
+            k=k.tobytes(), v=v.tobytes(),
+            kv_shape=list(k.shape), kv_dtype=str(k.dtype),
+        )
+        call = self._generate_prefilled(msg)
         try:
             async for chunk in call:
                 if chunk.error:

@@ -93,6 +93,69 @@ class SchedulerServicer:
             logger.exception("embed batch failed")
             return pb.EmbedBatchResponseProto(error=str(e))
 
+    async def PrefillExport(self, request: pb.PrefillExportRequestProto, context):
+        import numpy as np
+
+        loop = asyncio.get_running_loop()
+        try:
+            sampling = sampling_from_proto(request.sampling)
+            result = await loop.run_in_executor(
+                None, self.engine.prefill_export, list(request.input_ids), sampling
+            )
+            k, v = result["k"], result["v"]
+            return pb.PrefillExportResponseProto(
+                first_token=result["first_token"],
+                seq_len=result["seq_len"],
+                k=k.tobytes(), v=v.tobytes(),
+                kv_shape=list(k.shape), kv_dtype=str(k.dtype),
+            )
+        except Exception as e:
+            logger.exception("prefill export failed")
+            return pb.PrefillExportResponseProto(error=str(e))
+
+    async def GeneratePrefilled(self, request: pb.GeneratePrefilledRequestProto, context):
+        import numpy as np
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        base = request.base
+        sampling = sampling_from_proto(base.sampling)
+        shape = tuple(request.kv_shape)
+        k = np.frombuffer(request.k, dtype=request.kv_dtype).reshape(shape)
+        v = np.frombuffer(request.v, dtype=request.kv_dtype).reshape(shape)
+
+        def on_output(out) -> None:  # engine thread
+            loop.call_soon_threadsafe(q.put_nowait, out)
+
+        rid = base.rid
+        await loop.run_in_executor(
+            None,
+            lambda: self.engine.submit_prefilled(
+                list(base.input_ids), request.first_token, k, v, sampling,
+                rid=rid, on_output=on_output,
+            ),
+        )
+        try:
+            while True:
+                out = await q.get()
+                yield pb.GenerateChunk(
+                    rid=rid,
+                    token_ids=out.new_token_ids,
+                    logprobs=out.logprobs,
+                    finished=out.finished,
+                    finish_reason=out.finish_reason or "",
+                    matched_stop_token=(
+                        out.matched_stop if isinstance(out.matched_stop, int) else -1
+                    ),
+                    prompt_tokens=out.prompt_tokens,
+                    cached_tokens=out.cached_tokens,
+                    output_tokens=out.output_tokens,
+                )
+                if out.finished:
+                    return
+        finally:
+            self.engine.abort(rid)
+
     async def Abort(self, request: pb.AbortRequestProto, context):
         return pb.AbortResponseProto(ok=self.engine.abort(request.rid))
 
@@ -152,6 +215,16 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             request_deserializer=pb.EmbedRequestProto.FromString,
             response_serializer=pb.EmbedResponseProto.SerializeToString,
         ),
+        "PrefillExport": grpc.unary_unary_rpc_method_handler(
+            servicer.PrefillExport,
+            request_deserializer=pb.PrefillExportRequestProto.FromString,
+            response_serializer=pb.PrefillExportResponseProto.SerializeToString,
+        ),
+        "GeneratePrefilled": grpc.unary_stream_rpc_method_handler(
+            servicer.GeneratePrefilled,
+            request_deserializer=pb.GeneratePrefilledRequestProto.FromString,
+            response_serializer=pb.GenerateChunk.SerializeToString,
+        ),
         "EmbedBatch": grpc.unary_unary_rpc_method_handler(
             servicer.EmbedBatch,
             request_deserializer=pb.EmbedBatchRequestProto.FromString,
@@ -194,8 +267,8 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
 async def serve_worker_async(engine, port: int, host: str = "0.0.0.0") -> grpc.aio.Server:
     server = grpc.aio.server(
         options=[
-            ("grpc.max_send_message_length", 64 * 1024 * 1024),
-            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 512 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 512 * 1024 * 1024),
         ]
     )
     server.add_generic_rpc_handlers((_handlers(SchedulerServicer(engine)),))

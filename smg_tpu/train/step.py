@@ -36,6 +36,7 @@ def make_train_step(
     mesh,
     rules: ShardingRules | None = None,
     learning_rate: float = 1e-4,
+    use_ring_attention: bool | None = None,
 ):
     """Returns (init_fn, step_fn); both jitted with explicit shardings.
 
@@ -61,8 +62,13 @@ def make_train_step(
         params = module.init_params(cfg, key)
         return TrainState(params=params, opt_state=tx.init(params), step=jnp.int32(0))
 
+    if use_ring_attention is None:
+        # default on when the mesh actually shards the sequence
+        use_ring_attention = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+    ring_mesh = mesh if use_ring_attention else None
+
     def loss_fn(params, tokens, targets, mask):
-        logits = module.forward_train(params, cfg, inv_freq, tokens)
+        logits = module.forward_train(params, cfg, inv_freq, tokens, ring_mesh=ring_mesh)
         m = mask.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,148 @@
+"""Prefill/Decode disaggregation: KV handoff between two engines must
+reproduce single-engine outputs exactly (reference: PD routing mode +
+NIXL/Mooncake connectors, SURVEY.md §2.5)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient
+from smg_tpu.gateway.workers import Worker, WorkerType
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def make_engine(model_id="tiny-test") -> Engine:
+    return Engine(
+        EngineConfig(
+            model=tiny_test_config(),
+            cache=CacheConfig(page_size=16, num_pages=128, auto_size=False, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+                prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4,),
+            ),
+            dtype="float32",
+            model_id=model_id,
+        )
+    )
+
+
+def test_engine_level_kv_handoff():
+    """prefill_export on engine A + submit_prefilled on engine B == local
+    generation, token for token (greedy)."""
+    a = make_engine()
+    b = make_engine()
+    prompt = list(range(5, 45))  # 40 tokens
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, ignore_eos=True)
+
+    ref = a.generate(prompt_ids=prompt, sampling=sp)
+    a.flush_cache()
+
+    export = a.prefill_export(prompt, sp)
+    assert export["first_token"] == ref.token_ids[0]
+    assert export["seq_len"] == 40
+    assert export["k"].shape[1] == 3  # ceil(40/16) pages
+
+    outs = []
+    done = threading.Event()
+
+    def cb(o):
+        outs.append(o)
+        if o.finished:
+            done.set()
+
+    b.submit_prefilled(prompt, export["first_token"], export["k"], export["v"], sp,
+                       on_output=cb)
+    deadline = 300
+    while not done.is_set() and deadline:
+        b.step()
+        deadline -= 1
+    tokens = [t for o in outs for t in o.new_token_ids]
+    assert tokens == ref.token_ids, (tokens, ref.token_ids)
+    # the decode engine never prefilled the prompt
+    assert b.scheduler.num_prefill_tokens == 0
+    a.stop(); b.stop()
+
+
+@pytest.fixture(scope="module")
+def pd_gateway():
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+    p_engine = make_engine()
+    d_engine = make_engine()
+
+    async def _setup():
+        ctx.registry.add(Worker(
+            worker_id="prefill-0", client=InProcWorkerClient(p_engine),
+            model_id="tiny-test", worker_type=WorkerType.PREFILL,
+        ))
+        ctx.registry.add(Worker(
+            worker_id="decode-0", client=InProcWorkerClient(d_engine),
+            model_id="tiny-test", worker_type=WorkerType.DECODE,
+        ))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=120)
+
+    tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.client = run, tc
+    h.p_engine, h.d_engine = p_engine, d_engine
+    yield h
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+    p_engine.stop(); d_engine.stop()
+
+
+def test_pd_chat_through_gateway(pd_gateway):
+    async def go():
+        resp = await pd_gateway.client.post(
+            "/v1/chat/completions",
+            json={"model": "tiny-test",
+                  "messages": [{"role": "user", "content": "w5 w6 w7"}],
+                  "max_tokens": 6, "temperature": 0, "ignore_eos": True},
+        )
+        return resp.status, await resp.json()
+
+    status, body = pd_gateway.run(go())
+    assert status == 200, body
+    assert body["choices"][0]["message"]["content"].startswith("w")
+    assert body["usage"]["completion_tokens"] == 6
+    # prefill ran on the prefill engine, decode tokens on the decode engine
+    assert pd_gateway.p_engine.scheduler.num_prefill_tokens > 0
+    assert pd_gateway.d_engine.scheduler.num_prefill_tokens == 0
+    assert pd_gateway.d_engine.scheduler.num_decode_tokens > 0
+
+
+def test_pd_streaming(pd_gateway):
+    async def go():
+        resp = await pd_gateway.client.post(
+            "/v1/chat/completions",
+            json={"model": "tiny-test",
+                  "messages": [{"role": "user", "content": "w9 w10"}],
+                  "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+                  "stream": True},
+        )
+        return await resp.text()
+
+    raw = pd_gateway.run(go())
+    frames = [l for l in raw.splitlines() if l.startswith("data: ")]
+    assert frames[-1] == "data: [DONE]"
+    assert len(frames) >= 4

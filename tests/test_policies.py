@@ -160,3 +160,34 @@ def test_radix_tree_prefix_match():
     t.remove_worker("w0")
     m2 = t.prefix_match("hello world!")
     assert "w0" not in m2
+
+
+def test_native_radix_parity_with_python():
+    """Native C++ tree and Python tree agree on random workloads
+    (skipped when no toolchain built the native library)."""
+    import random
+
+    from smg_tpu.kv_index import RadixTree
+    from smg_tpu.kv_index.native import native_available, NativeRadixTree
+
+    if not native_available():
+        pytest.skip("native radix library not built")
+    rng = random.Random(0)
+    py = RadixTree()
+    nat = NativeRadixTree()
+    seqs = []
+    for i in range(200):
+        base = seqs[rng.randrange(len(seqs))][: rng.randrange(1, 20)] if seqs and rng.random() < 0.5 else []
+        seq = base + [rng.randrange(64) for _ in range(rng.randrange(1, 30))]
+        seqs.append(seq)
+        w = f"w{rng.randrange(4)}"
+        py.insert(seq, w)
+        nat.insert(seq, w)
+    for _ in range(100):
+        probe = seqs[rng.randrange(len(seqs))] + [rng.randrange(64)]
+        assert py.prefix_match(probe) == nat.prefix_match(probe)
+    py.remove_worker("w1")
+    nat.remove_worker("w1")
+    for _ in range(50):
+        probe = seqs[rng.randrange(len(seqs))]
+        assert py.prefix_match(probe) == nat.prefix_match(probe)
