@@ -1,0 +1,51 @@
+"""Checkpoint save/load via orbax.
+
+Reference: no model checkpoints exist in the reference (inference gateway,
+SURVEY.md §5) — weight handling lived in external engines.  In-tree engine =
+in-tree checkpoints: params save/restore with sharding-aware loading, for
+warm restarts and for persisting converted/fine-tuned weights.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("engine.checkpoint")
+
+
+def save_params(path: str, params) -> None:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    logger.info("saved checkpoint to %s", path)
+
+
+def load_params(path: str, like=None, shardings=None):
+    """Restore params.  ``like`` (a pytree of arrays or ShapeDtypeStructs)
+    drives dtype/shape; ``shardings`` places shards directly on the mesh."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        if shardings is not None:
+            abstract = jax.tree.map(
+                lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+                like, shardings,
+            )
+        else:
+            # inherit each template leaf's current placement
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                like,
+            )
+        restored = ckptr.restore(path, abstract)
+    else:
+        restored = ckptr.restore(path)
+    logger.info("restored checkpoint from %s", path)
+    return restored

@@ -180,8 +180,8 @@ class ModelRunner:
         self._compiled[k] = fn
         return fn
 
-    def _prefill_batched_fn(self, G: int, T: int, mp: int):
-        k = ("prefill_batched", G, T, mp)
+    def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False):
+        k = ("prefill_batched", G, T, mp, no_ctx)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -190,7 +190,8 @@ class ModelRunner:
         def step(params, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
                  key, temps, topks, topps, minps):
             logits, kc, vc = module.forward_prefill_batched(
-                params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables
+                params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
+                no_ctx=no_ctx,
             )
             toks, lps = _pick_sampler()(logits, key, temps, topks, topps, minps)
             return toks, lps, kc, vc
@@ -243,7 +244,8 @@ class ModelRunner:
             ftopks[i] = topks[i]
             ftopps[i] = topps[i]
             fminps[i] = minps[i]
-        fn = self._prefill_batched_fn(G, T, mp)
+        no_ctx = all(c[1] == 0 for c in chunks)
+        fn = self._prefill_batched_fn(G, T, mp, no_ctx)
         toks, lps, self.k_cache, self.v_cache = fn(
             self.params,
             self.inv_freq,

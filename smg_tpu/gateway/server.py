@@ -81,6 +81,7 @@ class AppContext:
         self.storage = MemoryStorage()
         self.mcp = McpRegistry()
         self.responses = ResponsesHandler(self.router, self.storage, self.mcp)
+        self.discovery = None  # attached by build_app when running in-cluster
 
 
 INFERENCE_ROUTES = frozenset(
@@ -192,9 +193,19 @@ def build_app(ctx: AppContext) -> web.Application:
 
     async def _start_background(app):
         ctx.health_monitor.start()
+        from smg_tpu.gateway.discovery import KubeApi, ServiceDiscovery
+
+        if ctx.discovery is None:
+            api = KubeApi()  # namespace from the service-account mount
+            if api.available:
+                ctx.discovery = ServiceDiscovery(ctx.registry, api=api)
+        if ctx.discovery is not None:
+            ctx.discovery.start()
 
     async def _stop_background(app):
         ctx.health_monitor.stop()
+        if ctx.discovery is not None:
+            await ctx.discovery.aclose()
 
     app.on_startup.append(_start_background)
     app.on_cleanup.append(_stop_background)

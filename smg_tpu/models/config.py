@@ -66,6 +66,9 @@ class ModelConfig:
             eos_token_ids=eos_ids,
             bos_token_id=cfg.get("bos_token_id", 1),
             dtype=dtype,
+            num_experts=cfg.get("num_experts", cfg.get("num_routed_experts", 0)) or 0,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 0) or 0,
+            moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
         )
 
     @classmethod
@@ -121,8 +124,22 @@ def llama3_70b_config() -> ModelConfig:
     )
 
 
+def tiny_moe_config() -> ModelConfig:
+    """Tiny Qwen-MoE-style config for CPU tests: 4 experts, top-2."""
+    import dataclasses
+
+    return dataclasses.replace(
+        tiny_test_config(),
+        arch="qwen_moe",
+        num_experts=4,
+        num_experts_per_tok=2,
+        moe_intermediate_size=128,
+    )
+
+
 PRESETS = {
     "tiny": tiny_test_config,
+    "tiny-moe": tiny_moe_config,
     "llama3.2-1b": llama32_1b_config,
     "llama3-8b": llama3_8b_config,
     "llama3-70b": llama3_70b_config,

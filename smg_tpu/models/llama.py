@@ -48,19 +48,29 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     def norm_init(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
+    layers: Params = {
+        "attn_norm": jnp.ones((L, E), dtype),
+        "wq": norm_init(ks[1], (L, E, H, D), 0.02),
+        "wk": norm_init(ks[2], (L, E, K, D), 0.02),
+        "wv": norm_init(ks[3], (L, E, K, D), 0.02),
+        "wo": norm_init(ks[4], (L, H, D, E), 0.02 / math.sqrt(2 * L)),
+        "mlp_norm": jnp.ones((L, E), dtype),
+    }
+    if cfg.num_experts > 0:
+        # MoE layers (Qwen-MoE family): router + stacked expert FFNs
+        X = cfg.num_experts
+        Fm = cfg.moe_intermediate_size or F
+        layers["router"] = norm_init(jax.random.fold_in(key, 7), (L, E, X), 0.02)
+        layers["w_gate"] = norm_init(ks[5], (L, X, E, Fm), 0.02)
+        layers["w_up"] = norm_init(ks[6], (L, X, E, Fm), 0.02)
+        layers["w_down"] = norm_init(ks[7], (L, X, Fm, E), 0.02 / math.sqrt(2 * L))
+    else:
+        layers["w_gate"] = norm_init(ks[5], (L, E, F), 0.02)
+        layers["w_up"] = norm_init(ks[6], (L, E, F), 0.02)
+        layers["w_down"] = norm_init(ks[7], (L, F, E), 0.02 / math.sqrt(2 * L))
     params: Params = {
         "embed": norm_init(ks[0], (V, E), 0.02),
-        "layers": {
-            "attn_norm": jnp.ones((L, E), dtype),
-            "wq": norm_init(ks[1], (L, E, H, D), 0.02),
-            "wk": norm_init(ks[2], (L, E, K, D), 0.02),
-            "wv": norm_init(ks[3], (L, E, K, D), 0.02),
-            "wo": norm_init(ks[4], (L, H, D, E), 0.02 / math.sqrt(2 * L)),
-            "mlp_norm": jnp.ones((L, E), dtype),
-            "w_gate": norm_init(ks[5], (L, E, F), 0.02),
-            "w_up": norm_init(ks[6], (L, E, F), 0.02),
-            "w_down": norm_init(ks[7], (L, F, E), 0.02 / math.sqrt(2 * L)),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((E,), dtype),
     }
     if not cfg.tie_word_embeddings:
@@ -70,19 +80,26 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 
 def logical_axes(cfg: ModelConfig) -> Params:
     """Pytree of logical-axis tuples matching ``init_params`` exactly."""
+    layers: Params = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "q_heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "q_heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+    }
+    if cfg.num_experts > 0:
+        layers["router"] = ("layers", "embed", None)
+        layers["w_gate"] = ("layers", "experts", "embed", "ffn")
+        layers["w_up"] = ("layers", "experts", "embed", "ffn")
+        layers["w_down"] = ("layers", "experts", "ffn", "embed")
+    else:
+        layers["w_gate"] = ("layers", "embed", "ffn")
+        layers["w_up"] = ("layers", "embed", "ffn")
+        layers["w_down"] = ("layers", "ffn", "embed")
     ax: Params = {
         "embed": ("vocab", "embed"),
-        "layers": {
-            "attn_norm": ("layers", "embed"),
-            "wq": ("layers", "embed", "q_heads", "head_dim"),
-            "wk": ("layers", "embed", "kv_heads", "head_dim"),
-            "wv": ("layers", "embed", "kv_heads", "head_dim"),
-            "wo": ("layers", "q_heads", "head_dim", "embed"),
-            "mlp_norm": ("layers", "embed"),
-            "w_gate": ("layers", "embed", "ffn"),
-            "w_up": ("layers", "embed", "ffn"),
-            "w_down": ("layers", "ffn", "embed"),
-        },
+        "layers": layers,
         "final_norm": ("embed",),
     }
     if not cfg.tie_word_embeddings:
@@ -114,10 +131,36 @@ def _qkv(layer: Params, cfg: ModelConfig, h: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
+def _mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    if "router" in layer:
+        return _moe_mlp(layer, h, cfg)
     gate = jnp.einsum("...e,ef->...f", h, layer["w_gate"])
     up = jnp.einsum("...e,ef->...f", h, layer["w_up"])
     return jnp.einsum("...f,fe->...e", jax.nn.silu(gate) * up, layer["w_down"])
+
+
+def _moe_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Mixture-of-experts FFN (Qwen-MoE family), EP-sharded dense dispatch.
+
+    TPU-first formulation: all experts computed with a gating mask — the
+    expert dim shards over the ``ep`` mesh axis so each device computes its
+    expert shard for every token and GSPMD psums the combine.  Dense dispatch
+    trades FLOPs (num_experts/top_k x) for zero routing collectives and
+    static shapes; sorted token dispatch is the planned optimization for
+    large expert counts."""
+    X = layer["router"].shape[-1]
+    k = max(cfg.num_experts_per_tok, 1)
+    logits = jnp.einsum("...e,ex->...x", h, layer["router"]).astype(jnp.float32)
+    top_vals, top_idx = jax.lax.top_k(logits, k)  # [..., k]
+    top_probs = jax.nn.softmax(top_vals, axis=-1)  # normalized over top-k (qwen)
+    one_hot = jax.nn.one_hot(top_idx, X, dtype=jnp.float32)  # [..., k, X]
+    gates = jnp.einsum("...kx,...k->...x", one_hot, top_probs)  # [..., X]
+
+    g = jnp.einsum("...e,xef->...xf", h, layer["w_gate"])
+    u = jnp.einsum("...e,xef->...xf", h, layer["w_up"])
+    y = jnp.einsum("...xf,xfe->...xe", jax.nn.silu(g) * u, layer["w_down"])
+    out = jnp.einsum("...xe,...x->...e", y.astype(jnp.float32), gates)
+    return out.astype(h.dtype)
 
 
 def forward_prefill(
@@ -159,7 +202,7 @@ def forward_prefill(
         attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
         h = h + jnp.einsum("thd,hde->te", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn)
+        h = h + _mlp(layer, hn, cfg)
         return (h, k_cache, v_cache), None
 
     (h, k_cache, v_cache), _ = jax.lax.scan(
@@ -215,7 +258,7 @@ def forward_decode(
         attn = attention_decode(q, k_cache[l], v_cache[l], page_tables, positions, scale)
         h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn)
+        h = h + _mlp(layer, hn, cfg)
         return (h, k_cache, v_cache), None
 
     (h, k_cache, v_cache), _ = jax.lax.scan(
@@ -237,10 +280,16 @@ def forward_prefill_batched(
     k_cache: jnp.ndarray,  # [L, P, ps, K*D]
     v_cache: jnp.ndarray,
     page_tables: jnp.ndarray,  # [G, mp]
+    no_ctx: bool = False,  # static: all rows cold (prefix 0, single chunk)
 ):
     """Prefill several sequences in one device call (fills the MXU and
     amortizes dispatch; single-sequence prefill wastes both).  Returns
-    (last_token_logits [G, V], k_cache, v_cache)."""
+    (last_token_logits [G, V], k_cache, v_cache).
+
+    ``no_ctx=True`` (every row is a cold single-chunk prompt — the common
+    case) attends over the chunk's own K/V instead of gathering the
+    sequence's full page range, cutting attention reads by max_seq_len/T.
+    """
     G_, T = tokens.shape
     ps = k_cache.shape[2]
     mp = page_tables.shape[1]
@@ -266,15 +315,19 @@ def forward_prefill_batched(
         k_cache, v_cache = scatter_kv_pages_full(
             k_cache, v_cache, l, k.reshape(G_ * T, K, D), v.reshape(G_ * T, K, D), dest
         )
-        kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
-        vl = v_cache[l][page_tables]
-        S = mp * ps
-        k_ctx = kl.reshape(G_, S, K, D)
-        v_ctx = vl.reshape(G_, S, K, D)
-        attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale)
+        if no_ctx:
+            # cold prompts: the chunk IS the whole context
+            attn = attention_prefill_batched(q, k, v, pos, ctx_lens, scale)
+        else:
+            kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
+            vl = v_cache[l][page_tables]
+            S = mp * ps
+            k_ctx = kl.reshape(G_, S, K, D)
+            v_ctx = vl.reshape(G_, S, K, D)
+            attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale)
         h = h + jnp.einsum("gthd,hde->gte", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn)
+        h = h + _mlp(layer, hn, cfg)
         return (h, k_cache, v_cache), None
 
     (h, k_cache, v_cache), _ = jax.lax.scan(
@@ -350,7 +403,7 @@ def forward_decode_horizon(
             )
         h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn)
+        h = h + _mlp(layer, hn, cfg)
         return (h, hk_all, hv_all), None
 
     (h, hk_all, hv_all), _ = jax.lax.scan(
@@ -395,7 +448,7 @@ def forward_embed(
         attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
         h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn)
+        h = h + _mlp(layer, hn, cfg)
         return h, None
 
     h, _ = jax.lax.scan(layer_body, h, params["layers"])
@@ -448,7 +501,7 @@ def forward_train(
             attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
         h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn)
+        h = h + _mlp(layer, hn, cfg)
         return h, None
 
     h, _ = jax.lax.scan(layer_body, h, params["layers"])

@@ -18,12 +18,15 @@ def register_model(arch: str, module: ModuleType) -> None:
 
 def get_model(arch: str) -> ModuleType:
     if arch not in _REGISTRY:
-        if arch in ("llama", "qwen", "mistral"):
+        if arch in ("llama", "qwen", "mistral", "qwen_moe"):
             from smg_tpu.models import llama
 
+            # one functional module serves the dense family and the MoE
+            # variants (the MLP dispatches on cfg.num_experts)
             _REGISTRY.setdefault("llama", llama)
             _REGISTRY.setdefault("qwen", llama)
             _REGISTRY.setdefault("mistral", llama)
+            _REGISTRY.setdefault("qwen_moe", llama)
         else:
             raise KeyError(
                 f"unsupported model architecture: {arch!r} "

@@ -31,10 +31,17 @@ def _hf_key_map(cfg, n_layers: int) -> dict[str, tuple[str, ...]]:
         ("layers", "wv"): "model.layers.{i}.self_attn.v_proj.weight",
         ("layers", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
         ("layers", "mlp_norm"): "model.layers.{i}.post_attention_layernorm.weight",
-        ("layers", "w_gate"): "model.layers.{i}.mlp.gate_proj.weight",
-        ("layers", "w_up"): "model.layers.{i}.mlp.up_proj.weight",
-        ("layers", "w_down"): "model.layers.{i}.mlp.down_proj.weight",
     }
+    if cfg.num_experts > 0:
+        # Qwen-MoE naming: router = mlp.gate.weight, experts under mlp.experts.{e}
+        m[("layers", "router")] = "model.layers.{i}.mlp.gate.weight"
+        m[("layers", "w_gate")] = "model.layers.{i}.mlp.experts.{e}.gate_proj.weight"
+        m[("layers", "w_up")] = "model.layers.{i}.mlp.experts.{e}.up_proj.weight"
+        m[("layers", "w_down")] = "model.layers.{i}.mlp.experts.{e}.down_proj.weight"
+    else:
+        m[("layers", "w_gate")] = "model.layers.{i}.mlp.gate_proj.weight"
+        m[("layers", "w_up")] = "model.layers.{i}.mlp.up_proj.weight"
+        m[("layers", "w_down")] = "model.layers.{i}.mlp.down_proj.weight"
     if not cfg.tie_word_embeddings:
         m[("lm_head",)] = "lm_head.weight"
     return m
@@ -45,7 +52,10 @@ def _transform(path: tuple[str, ...], w: np.ndarray, cfg) -> np.ndarray:
     E, H, K, D, F = (
         cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size,
     )
+    del F  # linear transforms below are shape-agnostic transposes
     leaf = path[-1]
+    if leaf == "router":
+        return w.transpose(1, 0)  # [E, n_experts]
     if leaf == "wq":
         return w.reshape(H, D, E).transpose(2, 0, 1)  # [E, H, D]
     if leaf in ("wk", "wv"):
@@ -95,7 +105,17 @@ def load_params(engine_cfg, mesh=None, rules=None):
     key_map = _hf_key_map(cfg, cfg.num_layers)
     params: dict = {"layers": {}}
     for path_key, tmpl in key_map.items():
-        if "{i}" in tmpl:
+        if "{e}" in tmpl:
+            # MoE expert weights: stack experts within each layer
+            stack = [
+                np.stack([
+                    _transform(path_key, fetch(tmpl.format(i=i, e=e)), cfg)
+                    for e in range(cfg.num_experts)
+                ])
+                for i in range(cfg.num_layers)
+            ]
+            arr = np.stack(stack)  # [L, X, ...]
+        elif "{i}" in tmpl:
             stack = [
                 _transform(path_key, fetch(tmpl.format(i=i)), cfg)
                 for i in range(cfg.num_layers)

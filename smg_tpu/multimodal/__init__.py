@@ -1,0 +1,30 @@
+"""Multimodal preprocessing — TPU-native image pipeline.
+
+Reference: ``crates/multimodal`` (llm-multimodal, 22k LoC + OpenCV C++ shim):
+gateway-side image/video/audio preprocessing with per-model vision processors
+(SURVEY.md §2.2).  Here the pixel math (resize/normalize/patchify) runs as
+jax ops — on-device when an accelerator is present — instead of OpenCV on the
+CPU; decoding (PNG/JPEG) uses PIL when available.
+"""
+
+from smg_tpu.multimodal.image import (
+    normalize_image,
+    patchify,
+    resize_image,
+    smart_resize,
+)
+from smg_tpu.multimodal.processor import (
+    ImageProcessor,
+    Qwen2VLImageProcessor,
+    get_image_processor,
+)
+
+__all__ = [
+    "resize_image",
+    "normalize_image",
+    "patchify",
+    "smart_resize",
+    "ImageProcessor",
+    "Qwen2VLImageProcessor",
+    "get_image_processor",
+]

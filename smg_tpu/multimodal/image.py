@@ -1,0 +1,101 @@
+"""Image ops as jax computations (resize/normalize/patchify).
+
+Replaces the reference's OpenCV/C++ preprocessing path
+(``crates/multimodal/src/opencv_buffer_capture.cpp``) with XLA-compiled ops
+that run on the serving accelerator.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# CLIP/SigLIP-style defaults (per-model processors override)
+DEFAULT_MEAN = (0.48145466, 0.4578275, 0.40821073)
+DEFAULT_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def resize_image(img: jnp.ndarray, height: int, width: int) -> jnp.ndarray:
+    """Bilinear resize [H, W, C] -> [height, width, C] (antialiased)."""
+    img = jnp.asarray(img)
+    if img.dtype == jnp.uint8:
+        img = img.astype(jnp.float32)
+    return jax.image.resize(img, (height, width, img.shape[-1]), method="bilinear")
+
+
+def normalize_image(
+    img: jnp.ndarray,
+    mean: tuple = DEFAULT_MEAN,
+    std: tuple = DEFAULT_STD,
+    rescale: float = 1.0 / 255.0,
+) -> jnp.ndarray:
+    """uint8/float [H, W, C] -> normalized float32."""
+    img = jnp.asarray(img, jnp.float32) * rescale
+    return (img - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+def patchify(
+    img: jnp.ndarray, patch_size: int, merge_size: int = 1
+) -> tuple[jnp.ndarray, tuple[int, int]]:
+    """[H, W, C] -> (patches [n, patch_size*patch_size*C], (gh, gw)).
+
+    H and W must be multiples of patch_size * merge_size (use smart_resize
+    first).  Patch order is row-major over the (gh, gw) grid, matching
+    ViT-style positional layouts."""
+    H, W, C = img.shape
+    ps = patch_size
+    gh, gw = H // ps, W // ps
+    x = img.reshape(gh, ps, gw, ps, C)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(gh * gw, ps * ps * C)
+    return x, (gh, gw)
+
+
+def smart_resize(
+    height: int,
+    width: int,
+    factor: int = 28,
+    min_pixels: int = 56 * 56,
+    max_pixels: int = 14 * 14 * 4 * 1280,
+) -> tuple[int, int]:
+    """Qwen2-VL resize rule: round dims to ``factor`` keeping the pixel count
+    within [min_pixels, max_pixels] and aspect ratio (reference:
+    vision/processors/qwen2_vl)."""
+    if max(height, width) / min(height, width) > 200:
+        raise ValueError("absolute aspect ratio must be < 200")
+    h_bar = max(factor, round(height / factor) * factor)
+    w_bar = max(factor, round(width / factor) * factor)
+    if h_bar * w_bar > max_pixels:
+        beta = math.sqrt((height * width) / max_pixels)
+        h_bar = math.floor(height / beta / factor) * factor
+        w_bar = math.floor(width / beta / factor) * factor
+    elif h_bar * w_bar < min_pixels:
+        beta = math.sqrt(min_pixels / (height * width))
+        h_bar = math.ceil(height * beta / factor) * factor
+        w_bar = math.ceil(width * beta / factor) * factor
+    return h_bar, w_bar
+
+
+def decode_image(data: bytes) -> jnp.ndarray:
+    """PNG/JPEG bytes -> [H, W, 3] uint8 array (PIL when available)."""
+    import io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("image decoding requires pillow") from e
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    import numpy as np
+
+    return jnp.asarray(np.asarray(img))
+
+
+def decode_data_url(url: str) -> jnp.ndarray:
+    """data:image/...;base64,... -> image array."""
+    import base64
+
+    if not url.startswith("data:"):
+        raise ValueError("only data: URLs decodable without egress")
+    _, b64 = url.split(",", 1)
+    return decode_image(base64.b64decode(b64))

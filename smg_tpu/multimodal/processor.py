@@ -1,0 +1,104 @@
+"""Per-model image processors (reference: ``crates/multimodal/src/vision/
+processors/`` x11 + registry).  Each turns a raw image into the pixel tensor +
+grid metadata its vision tower expects, plus the number of image placeholder
+tokens for prompt expansion."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from smg_tpu.multimodal.image import (
+    DEFAULT_MEAN,
+    DEFAULT_STD,
+    normalize_image,
+    patchify,
+    resize_image,
+    smart_resize,
+)
+
+
+@dataclass
+class ProcessedImage:
+    pixel_values: jnp.ndarray  # [n_patches, patch_dim]
+    grid: tuple[int, int]  # (gh, gw) patch grid
+    num_placeholder_tokens: int
+
+
+class ImageProcessor:
+    name = "base"
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        raise NotImplementedError
+
+
+class Qwen2VLImageProcessor(ImageProcessor):
+    """Qwen2-VL: smart-resize to factor patch*merge, 2x2 patch merging
+    (reference: vision/processors/qwen2_vl)."""
+
+    name = "qwen2_vl"
+
+    def __init__(self, patch_size: int = 14, merge_size: int = 2,
+                 min_pixels: int = 56 * 56, max_pixels: int = 14 * 14 * 4 * 1280):
+        self.patch_size = patch_size
+        self.merge_size = merge_size
+        self.min_pixels = min_pixels
+        self.max_pixels = max_pixels
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        H, W = img.shape[:2]
+        h2, w2 = smart_resize(
+            H, W, factor=self.patch_size * self.merge_size,
+            min_pixels=self.min_pixels, max_pixels=self.max_pixels,
+        )
+        img = resize_image(img, h2, w2)
+        img = normalize_image(img)
+        patches, grid = patchify(img, self.patch_size)
+        merged = grid[0] // self.merge_size * (grid[1] // self.merge_size)
+        return ProcessedImage(
+            pixel_values=patches, grid=grid, num_placeholder_tokens=merged
+        )
+
+
+class LlavaImageProcessor(ImageProcessor):
+    """Fixed-size square resize (LLaVA/CLIP style)."""
+
+    name = "llava"
+
+    def __init__(self, image_size: int = 336, patch_size: int = 14):
+        self.image_size = image_size
+        self.patch_size = patch_size
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        img = resize_image(img, self.image_size, self.image_size)
+        img = normalize_image(img, DEFAULT_MEAN, DEFAULT_STD)
+        patches, grid = patchify(img, self.patch_size)
+        return ProcessedImage(
+            pixel_values=patches, grid=grid,
+            num_placeholder_tokens=grid[0] * grid[1],
+        )
+
+
+_PROCESSORS = {
+    "qwen2_vl": Qwen2VLImageProcessor,
+    "qwen3_vl": Qwen2VLImageProcessor,
+    "llava": LlavaImageProcessor,
+}
+
+_MODEL_MAP = [
+    ("qwen2-vl", "qwen2_vl"),
+    ("qwen2.5-vl", "qwen2_vl"),
+    ("qwen3-vl", "qwen3_vl"),
+    ("llava", "llava"),
+]
+
+
+def get_image_processor(name_or_model: str) -> ImageProcessor:
+    key = (name_or_model or "").lower()
+    if key in _PROCESSORS:
+        return _PROCESSORS[key]()
+    for sub, name in _MODEL_MAP:
+        if sub in key:
+            return _PROCESSORS[name]()
+    return LlavaImageProcessor()

@@ -1,0 +1,184 @@
+"""Auxiliary subsystems: multimodal preprocessing, checkpointing, k8s
+discovery (against a fake API), weight loading."""
+
+import asyncio
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+# ---- multimodal ----
+
+def test_smart_resize_rules():
+    from smg_tpu.multimodal import smart_resize
+
+    h, w = smart_resize(1000, 748, factor=28)
+    assert h % 28 == 0 and w % 28 == 0
+    # tiny image scales up to min_pixels
+    h2, w2 = smart_resize(20, 20, factor=28, min_pixels=56 * 56)
+    assert h2 * w2 >= 56 * 56
+    # huge image scales down under max_pixels
+    h3, w3 = smart_resize(10000, 10000, factor=28, max_pixels=1280 * 28 * 28)
+    assert h3 * w3 <= 1280 * 28 * 28
+    with pytest.raises(ValueError):
+        smart_resize(10000, 10, factor=28)
+
+
+def test_patchify_roundtrip_order():
+    from smg_tpu.multimodal import patchify
+
+    img = jnp.arange(8 * 8 * 3, dtype=jnp.float32).reshape(8, 8, 3)
+    patches, grid = patchify(img, 4)
+    assert grid == (2, 2)
+    assert patches.shape == (4, 4 * 4 * 3)
+    # first patch == top-left block, row-major
+    np.testing.assert_array_equal(
+        np.asarray(patches[0]).reshape(4, 4, 3), np.asarray(img[:4, :4]))
+    np.testing.assert_array_equal(
+        np.asarray(patches[1]).reshape(4, 4, 3), np.asarray(img[:4, 4:]))
+
+
+def test_qwen2vl_processor():
+    from smg_tpu.multimodal import get_image_processor
+
+    proc = get_image_processor("Qwen2-VL-7B-Instruct")
+    assert proc.name == "qwen2_vl"
+    img = jnp.ones((300, 500, 3), jnp.uint8) * 128
+    out = proc.process(img)
+    gh, gw = out.grid
+    assert gh % 2 == 0 and gw % 2 == 0  # mergeable
+    assert out.num_placeholder_tokens == (gh // 2) * (gw // 2)
+    assert out.pixel_values.shape == (gh * gw, 14 * 14 * 3)
+    assert bool(jnp.isfinite(out.pixel_values).all())
+
+
+def test_data_url_rejects_http():
+    from smg_tpu.multimodal.image import decode_data_url
+
+    with pytest.raises(ValueError):
+        decode_data_url("http://example.com/x.png")
+
+
+# ---- checkpoint ----
+
+def test_checkpoint_roundtrip(tiny_cfg):
+    from smg_tpu.engine.checkpoint import load_params, save_params
+    from smg_tpu.models import llama
+
+    params = llama.init_params(tiny_cfg, jax.random.PRNGKey(0))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ckpt")
+        save_params(path, params)
+        restored = load_params(path, like=params)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- weight loading (HF safetensors) ----
+
+def test_safetensors_weight_loading(tiny_cfg):
+    from safetensors.numpy import save_file
+
+    from smg_tpu.engine.config import EngineConfig
+    from smg_tpu.models import llama
+    from smg_tpu.models.weights import load_params as load_hf
+    from smg_tpu.ops.rope import rope_frequencies
+
+    cfg = tiny_cfg
+    E, H, K, D, F, V, L = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                           cfg.head_dim, cfg.intermediate_size, cfg.vocab_size,
+                           cfg.num_layers)
+    rng = np.random.default_rng(0)
+
+    tensors = {
+        "model.embed_tokens.weight": rng.standard_normal((V, E), dtype=np.float32) * 0.02,
+        "model.norm.weight": np.ones(E, np.float32),
+        "lm_head.weight": rng.standard_normal((V, E), dtype=np.float32) * 0.02,
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(E, np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(E, np.float32)
+        tensors[p + "self_attn.q_proj.weight"] = rng.standard_normal((H * D, E), dtype=np.float32) * 0.02
+        tensors[p + "self_attn.k_proj.weight"] = rng.standard_normal((K * D, E), dtype=np.float32) * 0.02
+        tensors[p + "self_attn.v_proj.weight"] = rng.standard_normal((K * D, E), dtype=np.float32) * 0.02
+        tensors[p + "self_attn.o_proj.weight"] = rng.standard_normal((E, H * D), dtype=np.float32) * 0.02
+        tensors[p + "mlp.gate_proj.weight"] = rng.standard_normal((F, E), dtype=np.float32) * 0.02
+        tensors[p + "mlp.up_proj.weight"] = rng.standard_normal((F, E), dtype=np.float32) * 0.02
+        tensors[p + "mlp.down_proj.weight"] = rng.standard_normal((E, F), dtype=np.float32) * 0.02
+
+    with tempfile.TemporaryDirectory() as d:
+        save_file(tensors, os.path.join(d, "model.safetensors"))
+        ecfg = EngineConfig(model=cfg, model_path=d, dtype="float32")
+        params = load_hf(ecfg)
+        # parity: loaded params reproduce torch-convention linear layers
+        inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta, None))
+        logits = llama.forward_train(params, cfg, inv_freq, jnp.ones((1, 4), jnp.int32))
+        assert bool(jnp.isfinite(logits).all())
+        # spot-check a projection: our wq[l] == q_proj.T reshaped
+        wq0 = np.asarray(params["layers"]["wq"][0])  # [E, H, D]
+        ref = tensors["model.layers.0.self_attn.q_proj.weight"].reshape(H, D, E).transpose(2, 0, 1)
+        np.testing.assert_allclose(wq0, ref, atol=1e-6)
+
+
+# ---- k8s discovery with a fake API ----
+
+def test_service_discovery_add_remove():
+    from smg_tpu.gateway.discovery import (
+        DiscoveryConfig,
+        ServiceDiscovery,
+    )
+    from smg_tpu.gateway.workers import WorkerRegistry, WorkerType
+
+    class FakeApi:
+        def __init__(self):
+            self.pods = []
+
+        async def list_pods(self, selector):
+            return self.pods
+
+    class FakeClient:
+        def __init__(self, url):
+            self.url = url
+
+        async def get_model_info(self):
+            return {"model_id": "m-disc"}
+
+        async def close(self):
+            pass
+
+    def pod(name, ip, role="regular", port=None):
+        ann = {}
+        if port:
+            ann["smg.ai/grpc-port"] = str(port)
+        return {
+            "metadata": {"name": name, "labels": {"smg.ai/role": role},
+                         "annotations": ann},
+            "status": {"podIP": ip, "phase": "Running"},
+        }
+
+    async def go():
+        registry = WorkerRegistry()
+        api = FakeApi()
+        disc = ServiceDiscovery(
+            registry, DiscoveryConfig(), api=api, client_factory=FakeClient
+        )
+        api.pods = [pod("w0", "10.0.0.1"), pod("w1", "10.0.0.2", role="prefill", port=40001)]
+        await disc.sync_once()
+        ws = registry.list()
+        assert {w.worker_id for w in ws} == {"k8s-w0", "k8s-w1"}
+        w1 = registry.get("k8s-w1")
+        assert w1.worker_type == WorkerType.PREFILL
+        assert w1.url == "10.0.0.2:40001"
+        assert w1.model_id == "m-disc"
+        # pod disappears -> worker removed
+        api.pods = [pod("w0", "10.0.0.1")]
+        await disc.sync_once()
+        assert registry.get("k8s-w1") is None
+        assert registry.get("k8s-w0") is not None
+
+    asyncio.run(go())

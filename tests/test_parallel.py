@@ -75,3 +75,28 @@ def test_train_step_sharded(cpu_devices):
     assert np.isfinite(float(metrics["loss"]))
     assert np.isfinite(float(metrics["grad_norm"]))
     assert int(state.step) == 1
+
+
+def test_ep_sharded_moe_matches_single(cpu_devices):
+    """Expert-parallel MoE engine is token-exact vs single device."""
+    from smg_tpu.models.config import tiny_moe_config
+    import dataclasses
+
+    def eng(parallel, devs):
+        cfg = EngineConfig(
+            model=tiny_moe_config(),
+            parallel=parallel,
+            cache=CacheConfig(page_size=16, num_pages=64, auto_size=False, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+                prefill_token_buckets=(32, 64), decode_batch_buckets=(4,),
+            ),
+            dtype="float32",
+        )
+        return Engine(cfg, tokenizer=MockTokenizer(), devices=devs)
+
+    single = eng(ParallelConfig(), cpu_devices[:1])
+    ref = single.generate(prompt_ids=list(range(5, 30)), sampling=greedy())
+    ep2 = eng(ParallelConfig(ep=2), cpu_devices[:2])
+    res = ep2.generate(prompt_ids=list(range(5, 30)), sampling=greedy())
+    assert res.token_ids == ref.token_ids
