@@ -46,6 +46,10 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--max-concurrent-requests", type=int, default=256)
     g.add_argument("--gateway-tokenizer-path", default=None, dest="gateway_tokenizer_path",
                    help="tokenizer for gateway-side text processing (launch mode)")
+    g.add_argument("--mesh-port", type=int, default=None,
+                   help="enable HA mesh gossip on this port")
+    g.add_argument("--mesh-seed", action="append", default=[], dest="mesh_seeds",
+                   help="mesh seed peer host:port (repeatable)")
     g.add_argument("--log-level", default="INFO")
     g.add_argument("--prometheus-port", type=int, default=None)
 

@@ -141,6 +141,19 @@ async def _run_gateway(args) -> int:
             )
         )
 
+    mesh_node = None
+    if getattr(args, "mesh_port", None) is not None:
+        from smg_tpu.mesh import GossipConfig, GossipNode
+        from smg_tpu.mesh.adapters import WorkerSyncAdapter
+
+        mesh_node = GossipNode(
+            GossipConfig(host="0.0.0.0", port=args.mesh_port,
+                         seeds=list(getattr(args, "mesh_seeds", [])))
+        )
+        await mesh_node.start()
+        WorkerSyncAdapter(ctx.registry, mesh_node.state)
+        logger.info("HA mesh enabled on port %d", args.mesh_port)
+
     app = build_app(ctx)
     runner = web.AppRunner(app)
     await runner.setup()
@@ -153,5 +166,7 @@ async def _run_gateway(args) -> int:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if mesh_node is not None:
+            await mesh_node.stop()
         await runner.cleanup()
     return 0

@@ -1,0 +1,10 @@
+"""HA mesh: gossip membership + CRDT state sync between gateway peers.
+
+Reference: ``crates/mesh`` (smg-mesh) — SWIM-style gossip, CRDT KV with
+epoch-count merge, stream namespaces, partition detection (SURVEY.md §2.2).
+"""
+
+from smg_tpu.mesh.crdt import LwwMap
+from smg_tpu.mesh.gossip import GossipConfig, GossipNode
+
+__all__ = ["LwwMap", "GossipNode", "GossipConfig"]

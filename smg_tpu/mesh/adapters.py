@@ -1,0 +1,91 @@
+"""Gateway <-> mesh bridges.
+
+Reference: ``model_gateway/src/mesh/adapters/`` — ``worker_sync`` (worker
+CRDT namespace) and ``tree_sync`` (prefix-tree deltas) (SURVEY.md §2.1, §3.5).
+
+``WorkerSyncAdapter`` replicates worker registrations between gateway peers:
+local registry changes publish into the CRDT; merged remote entries register
+gRPC workers locally (so every gateway can route to every worker without a
+shared control plane).
+"""
+
+from __future__ import annotations
+
+from smg_tpu.mesh.crdt import LwwMap
+from smg_tpu.utils import get_logger
+
+logger = get_logger("mesh.adapters")
+
+WORKER_NS = "worker/"
+
+
+class WorkerSyncAdapter:
+    def __init__(self, registry, state: LwwMap, client_factory=None):
+        self.registry = registry
+        self.state = state
+        self._client_factory = client_factory or self._default_factory
+        self._remote: set[str] = set()  # worker ids created from mesh state
+        registry.on_change(self._on_local_change)
+        state.on_change(self._on_state_change)
+        # publish pre-existing local workers
+        for w in registry.list():
+            self._publish(w)
+
+    @staticmethod
+    def _default_factory(url: str):
+        from smg_tpu.rpc.client import GrpcWorkerClient
+
+        return GrpcWorkerClient(url)
+
+    # ---- local -> mesh ----
+
+    def _publish(self, worker) -> None:
+        if worker.worker_id in self._remote or not worker.url:
+            return
+        self.state.set(
+            WORKER_NS + worker.worker_id,
+            {
+                "url": worker.url,
+                "model_id": worker.model_id,
+                "type": worker.worker_type.value,
+            },
+        )
+
+    def _on_local_change(self, event: str, worker) -> None:
+        if worker.worker_id in self._remote:
+            return  # don't re-publish entries that came from the mesh
+        if event == "added":
+            self._publish(worker)
+        elif event == "removed":
+            self.state.delete(WORKER_NS + worker.worker_id)
+
+    # ---- mesh -> local ----
+
+    def _on_state_change(self, key: str, value, deleted: bool) -> None:
+        if not key.startswith(WORKER_NS):
+            return
+        wid = key[len(WORKER_NS):]
+        if deleted:
+            if wid in self._remote:
+                self._remote.discard(wid)
+                worker = self.registry.remove(wid)
+                if worker is not None:
+                    logger.info("mesh: removed remote worker %s", wid)
+            return
+        if self.registry.get(wid) is not None:
+            return  # already known (local or previously synced)
+        from smg_tpu.gateway.workers import Worker, WorkerType
+
+        try:
+            wtype = WorkerType(value.get("type", "regular"))
+        except ValueError:
+            wtype = WorkerType.REGULAR
+        client = self._client_factory(value["url"])
+        self._remote.add(wid)
+        self.registry.add(
+            Worker(
+                worker_id=wid, client=client, model_id=value.get("model_id", "default"),
+                worker_type=wtype, url=value["url"],
+            )
+        )
+        logger.info("mesh: registered remote worker %s (%s)", wid, value["url"])

@@ -1,0 +1,115 @@
+"""HA mesh: CRDT convergence + multi-node gossip in-process
+(reference: crates/mesh in-proc multi-node fixtures, SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from smg_tpu.mesh import GossipConfig, GossipNode, LwwMap
+
+
+def test_lww_map_merge_converges():
+    a = LwwMap("a")
+    b = LwwMap("b")
+    a.set("w1", {"url": "host1"})
+    b.set("w2", {"url": "host2"})
+    # cross-merge
+    b.merge(a.snapshot())
+    a.merge(b.snapshot())
+    assert a.items() == b.items() == {"w1": {"url": "host1"}, "w2": {"url": "host2"}}
+    # concurrent write on same key: deterministic winner both sides
+    a.set("k", "from-a")
+    b.set("k", "from-b")
+    a.merge(b.snapshot())
+    b.merge(a.snapshot())
+    assert a.get("k") == b.get("k")
+    # delete propagates via tombstone
+    a.delete("w1")
+    b.merge(a.snapshot())
+    assert b.get("w1") is None
+    # merge is idempotent
+    before = b.items()
+    b.merge(a.snapshot())
+    assert b.items() == before
+
+
+def test_lww_change_notifications():
+    a = LwwMap("a")
+    b = LwwMap("b")
+    seen = []
+    b.on_change(lambda k, v, d: seen.append((k, v, d)))
+    a.set("x", 1)
+    a.delete("y")
+    b.merge(a.snapshot())
+    assert ("x", 1, False) in seen
+    assert ("y", None, True) in seen
+
+
+def test_three_node_gossip_converges():
+    async def go():
+        n1 = GossipNode(GossipConfig(node_id="n1", interval_secs=60))
+        await n1.start()
+        n2 = GossipNode(GossipConfig(node_id="n2", seeds=[n1.addr], interval_secs=60))
+        await n2.start()
+        n3 = GossipNode(GossipConfig(node_id="n3", seeds=[n1.addr], interval_secs=60))
+        await n3.start()
+
+        n1.state.set("worker/a", {"url": "10.0.0.1"})
+        n3.state.set("worker/c", {"url": "10.0.0.3"})
+
+        # drive rounds deterministically
+        for _ in range(12):
+            await n1._round()
+            await n2._round()
+            await n3._round()
+        expected = {"worker/a": {"url": "10.0.0.1"}, "worker/c": {"url": "10.0.0.3"}}
+        assert n1.state.items() == expected
+        assert n2.state.items() == expected
+        assert n3.state.items() == expected
+        # full membership discovered everywhere
+        for n in (n1, n2, n3):
+            assert {m.node_id for m in n.alive_members()} == {"n1", "n2", "n3"}
+
+        # failure detection: kill n3, others mark it dead
+        await n3.stop()
+        n3._server = None
+        for _ in range(20):
+            await n1._round()
+            await n2._round()
+        dead = [m for m in n1.members.values() if m.node_id == "n3"]
+        assert dead and not dead[0].alive
+
+        await n1.stop()
+        await n2.stop()
+
+    asyncio.run(go())
+
+
+def test_worker_sync_adapter():
+    """Two gateways exchange worker registrations through the mesh CRDT."""
+    from smg_tpu.gateway.workers import Worker, WorkerRegistry, WorkerType
+    from smg_tpu.mesh.adapters import WorkerSyncAdapter
+
+    class FakeClient:
+        def __init__(self, url):
+            self.url = url
+
+    reg_a, reg_b = WorkerRegistry(), WorkerRegistry()
+    state_a, state_b = LwwMap("a"), LwwMap("b")
+    WorkerSyncAdapter(reg_a, state_a, client_factory=FakeClient)
+    WorkerSyncAdapter(reg_b, state_b, client_factory=FakeClient)
+
+    reg_a.add(Worker(worker_id="w-local", client=FakeClient("u"), model_id="m",
+                     worker_type=WorkerType.PREFILL, url="10.0.0.5:30001"))
+    # gossip would carry this; simulate one anti-entropy exchange
+    state_b.merge(state_a.snapshot())
+    synced = reg_b.get("w-local")
+    assert synced is not None
+    assert synced.url == "10.0.0.5:30001"
+    assert synced.worker_type == WorkerType.PREFILL
+    # b must NOT republish a remote worker as its own
+    assert state_b.get("worker/w-local")["url"] == "10.0.0.5:30001"
+    # removal propagates
+    reg_a.remove("w-local")
+    state_b.merge(state_a.snapshot())
+    assert reg_b.get("w-local") is None
