@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Generate an OpenAPI 3.1 spec from the protocol models.
+
+Reference: the reference generates client SDKs from its Rust protocol types
+via OpenAPI (``clients/openapi-gen``, ``Makefile:151-189``); here the pydantic
+models are the single source of truth.
+
+Usage: python scripts/gen_openapi.py [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_spec() -> dict:
+    from pydantic.json_schema import models_json_schema
+
+    from smg_tpu.protocols.anthropic import (
+        AnthropicMessagesRequest,
+        AnthropicMessagesResponse,
+    )
+    from smg_tpu.protocols.generate import GenerateRequest, GenerateResponse
+    from smg_tpu.protocols.openai import (
+        ChatCompletionRequest,
+        ChatCompletionResponse,
+        ChatCompletionStreamChunk,
+        CompletionRequest,
+        CompletionResponse,
+        EmbeddingRequest,
+        EmbeddingResponse,
+        ErrorResponse,
+        ModelList,
+    )
+    from smg_tpu.protocols.responses import ResponsesRequest, ResponsesResponse
+    from smg_tpu.version import __version__
+
+    models = [
+        ChatCompletionRequest, ChatCompletionResponse, ChatCompletionStreamChunk,
+        CompletionRequest, CompletionResponse,
+        EmbeddingRequest, EmbeddingResponse,
+        AnthropicMessagesRequest, AnthropicMessagesResponse,
+        ResponsesRequest, ResponsesResponse,
+        GenerateRequest, GenerateResponse,
+        ModelList, ErrorResponse,
+    ]
+    _, defs = models_json_schema(
+        [(m, "validation") for m in models],
+        ref_template="#/components/schemas/{model}",
+    )
+    schemas = defs.get("$defs", {})
+
+    def op(tag, summary, req_model=None, resp_model=None, streaming=False):
+        o = {
+            "tags": [tag],
+            "summary": summary + (" (set stream=true for SSE)" if streaming else ""),
+            "responses": {
+                "200": {"description": "OK"},
+                "400": {"$ref": "#/components/responses/Error"},
+            },
+        }
+        if req_model:
+            o["requestBody"] = {
+                "required": True,
+                "content": {"application/json": {
+                    "schema": {"$ref": f"#/components/schemas/{req_model}"}}},
+            }
+        if resp_model:
+            o["responses"]["200"] = {
+                "description": "OK",
+                "content": {"application/json": {
+                    "schema": {"$ref": f"#/components/schemas/{resp_model}"}}},
+            }
+        return o
+
+    paths = {
+        "/v1/chat/completions": {"post": op(
+            "openai", "Chat completion", "ChatCompletionRequest",
+            "ChatCompletionResponse", streaming=True)},
+        "/v1/completions": {"post": op(
+            "openai", "Text completion", "CompletionRequest",
+            "CompletionResponse", streaming=True)},
+        "/v1/embeddings": {"post": op(
+            "openai", "Embeddings", "EmbeddingRequest", "EmbeddingResponse")},
+        "/v1/messages": {"post": op(
+            "anthropic", "Anthropic Messages", "AnthropicMessagesRequest",
+            "AnthropicMessagesResponse", streaming=True)},
+        "/v1/responses": {"post": op(
+            "openai", "Responses API (agentic, MCP tool loop)",
+            "ResponsesRequest", "ResponsesResponse", streaming=True)},
+        "/generate": {"post": op(
+            "native", "Native generate (SGLang-compatible)",
+            "GenerateRequest", "GenerateResponse", streaming=True)},
+        "/v1/models": {"get": op("openai", "List models", None, "ModelList")},
+        "/v1/tokenize": {"post": op("native", "Tokenize text")},
+        "/v1/detokenize": {"post": op("native", "Detokenize ids")},
+        "/parse/function_call": {"post": op("native", "Parse tool calls from text")},
+        "/parse/reasoning": {"post": op("native", "Split reasoning from text")},
+        "/health": {"get": op("ops", "Liveness probe")},
+        "/readiness": {"get": op("ops", "Readiness probe")},
+        "/health_generate": {"get": op("ops", "End-to-end generation probe")},
+        "/metrics": {"get": op("ops", "Prometheus metrics")},
+        "/get_loads": {"get": op("ops", "Per-worker engine loads")},
+        "/flush_cache": {"post": op("ops", "Flush prefix caches")},
+        "/workers": {
+            "get": op("ops", "List workers"),
+            "post": op("ops", "Register a gRPC worker"),
+        },
+        "/v1/conversations": {"post": op("openai", "Create conversation")},
+    }
+
+    return {
+        "openapi": "3.1.0",
+        "info": {
+            "title": "smg-tpu gateway API",
+            "version": __version__,
+            "description": "TPU-native LLM serving: OpenAI/Anthropic-compatible "
+                           "APIs over an in-tree JAX/XLA/Pallas engine.",
+        },
+        "paths": paths,
+        "components": {
+            "schemas": schemas,
+            "responses": {
+                "Error": {
+                    "description": "Error",
+                    "content": {"application/json": {
+                        "schema": {"$ref": "#/components/schemas/ErrorResponse"}}},
+                }
+            },
+        },
+    }
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "openapi.json"
+    spec = build_spec()
+    with open(out, "w") as f:
+        json.dump(spec, f, indent=2)
+    print(f"wrote {out}: {len(spec['paths'])} paths, "
+          f"{len(spec['components']['schemas'])} schemas")
