@@ -1,0 +1,14 @@
+"""Grammar-constrained decoding (structured output).
+
+Reference capability: ``json_schema`` / ``regex`` / ``ebnf`` sampling params
+(``sglang_scheduler.proto``; enforced by the engines the reference routes to).
+Here: an incremental JSON acceptor + vocab-mask computation.  The engine
+applies the mask on the single-step decode path for constrained requests
+(constraints are inherently sequential — each step's mask depends on the
+previous token).
+"""
+
+from smg_tpu.constrained.json_fsm import JsonMachine
+from smg_tpu.constrained.token_filter import TokenFilter
+
+__all__ = ["JsonMachine", "TokenFilter"]

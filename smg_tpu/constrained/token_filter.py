@@ -1,0 +1,55 @@
+"""Vocab masking for constrained decoding.
+
+Given a tokenizer and an acceptor (``accepts(text)`` / ``complete(text)``),
+compute which token ids may extend the current output.  Piece strings are
+decoded once and cached; masks are memoized by accepted-text so repeated
+states (e.g. inside long strings) are cheap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("constrained")
+
+
+class TokenFilter:
+    def __init__(self, tokenizer, machine, vocab_size: int, eos_token_ids=()):
+        self.tok = tokenizer
+        self.machine = machine
+        self.vocab_size = vocab_size
+        self.eos_ids = set(eos_token_ids)
+        self._pieces: list[str] | None = None
+        self._mask_cache: dict[str, np.ndarray] = {}
+
+    def _piece_table(self) -> list[str]:
+        if self._pieces is None:
+            self._pieces = [
+                self.tok.decode([t], skip_special_tokens=False)
+                for t in range(self.vocab_size)
+            ]
+        return self._pieces
+
+    def allowed_mask(self, text_so_far: str) -> np.ndarray:
+        """Boolean [vocab] mask of tokens that keep the output prefix-valid.
+        EOS allowed iff the document is already complete."""
+        cached = self._mask_cache.get(text_so_far)
+        if cached is not None:
+            return cached
+        pieces = self._piece_table()
+        mask = np.zeros(self.vocab_size, bool)
+        complete = self.machine.complete(text_so_far)
+        for tid, piece in enumerate(pieces):
+            if tid in self.eos_ids:
+                mask[tid] = complete
+            elif piece and self.machine.accepts(text_so_far + piece):
+                # once complete, only whitespace extensions remain valid
+                mask[tid] = True
+        if len(self._mask_cache) < 512:
+            self._mask_cache[text_so_far] = mask
+        return mask
+
+    def is_finished(self, text_so_far: str) -> bool:
+        return self.machine.complete(text_so_far)

@@ -1,0 +1,130 @@
+"""Structured output: JSON acceptor + token filtering (no jax needed)."""
+
+import json
+
+import pytest
+
+from smg_tpu.constrained import JsonMachine, TokenFilter
+
+
+@pytest.fixture(scope="module")
+def m():
+    return JsonMachine()
+
+
+VALID_PREFIXES = [
+    "", "{", '{"', '{"key', '{"key"', '{"key":', '{"key": ', '{"key": 12',
+    '{"key": 12.', '{"key": 12.5e', '{"a": [1, 2', '{"a": {"b": tru',
+    '[', '[1,', '["x", nul', '  {"a"  :  "b"  ,', '"str with \\', '"esc \\u0A',
+    "-", "-1", "12e+",
+]
+
+COMPLETE_DOCS = [
+    "{}", "[]", '{"a": 1}', '[1, 2, 3]', '"hello"', "true", "null", "42",
+    '{"nested": {"x": [1, {"y": "z"}]}}', "-3.5e2",
+]
+
+INVALID = [
+    "}", "{]", '{"a" 1}', '{"a": 1,}x', "[1 2]", "tru e", '{"a"": 1}',
+    '{"a": 01}', "[1,,2]", '"bad \\q"', "{}extra",
+]
+
+
+def test_valid_prefixes(m):
+    for p in VALID_PREFIXES:
+        assert m.accepts(p), f"should accept prefix: {p!r}"
+
+
+def test_complete_docs(m):
+    for d in COMPLETE_DOCS:
+        assert m.accepts(d), f"should accept complete doc: {d!r}"
+        assert m.complete(d), f"should be complete: {d!r}"
+        json.loads(d)  # sanity
+
+
+def test_invalid_rejected(m):
+    for bad in INVALID:
+        assert not m.accepts(bad), f"should reject: {bad!r}"
+
+
+def test_complete_not_for_prefixes(m):
+    for p in ['{"a": 1', "[1, 2", '"unterminated', "12e"]:
+        assert not m.complete(p)
+
+
+def test_token_filter_masks():
+    from smg_tpu.tokenizer import MockTokenizer
+
+    class CharTokenizer:
+        """One char per token over a small alphabet, for exact mask checks."""
+
+        alphabet = '{}[]":, 0123456789abcdetrulnf-.'
+
+        def decode(self, ids, skip_special_tokens=False):
+            return "".join(
+                self.alphabet[t - 2] if 2 <= t - 0 and t - 2 < len(self.alphabet) else ""
+                for t in ids
+            )
+
+    tok = CharTokenizer()
+    vocab = len(tok.alphabet) + 2  # 0=eos, 1=unused
+    tf = TokenFilter(tok, JsonMachine(), vocab, eos_token_ids={0})
+
+    def allowed_chars(text):
+        mask = tf.allowed_mask(text)
+        return {tok.alphabet[t - 2] for t in range(2, vocab) if mask[t]}, mask[0]
+
+    chars, eos_ok = allowed_chars("")
+    assert "{" in chars and "[" in chars and '"' in chars and "}" not in chars
+    assert not eos_ok
+
+    chars, eos_ok = allowed_chars('{"a": 1')
+    assert "}" in chars and "," in chars and "0" in chars
+    assert "{" not in chars
+    assert not eos_ok  # doc not complete yet
+
+    chars, eos_ok = allowed_chars('{"a": 1}')
+    assert eos_ok  # complete: eos allowed
+    assert chars <= {" "}  # only whitespace may extend
+
+    # mask memoization
+    assert tf.allowed_mask('{"a": 1') is tf.allowed_mask('{"a": 1')
+
+
+def test_guided_generation_simulation(m):
+    """Greedy walk under the mask always terminates in valid JSON."""
+    from smg_tpu.constrained.token_filter import TokenFilter
+
+    class CharTokenizer:
+        alphabet = '{}[]":, 0123456789abcxyz-'
+
+        def decode(self, ids, skip_special_tokens=False):
+            return "".join(
+                self.alphabet[t - 1] if 1 <= t and t - 1 < len(self.alphabet) else ""
+                for t in ids
+            )
+
+    tok = CharTokenizer()
+    vocab = len(tok.alphabet) + 1
+    tf = TokenFilter(tok, m, vocab, eos_token_ids={0})
+
+    # simulate a model that prefers: { " a " : 1 } then eos
+    import numpy as np
+
+    preference = list('{"a": 1}') + ["<eos>"]
+    text = ""
+    for step in range(40):
+        mask = tf.allowed_mask(text)
+        want = preference[0] if preference else "<eos>"
+        if want == "<eos>":
+            if mask[0]:
+                break
+            tid = int(np.argmax(mask))  # fallback: any allowed
+        else:
+            tid = tok.alphabet.index(want) + 1 if mask[tok.alphabet.index(want) + 1] else int(np.argmax(mask))
+        piece = tok.decode([tid])
+        text += piece
+        if preference and piece == preference[0]:
+            preference.pop(0)
+    assert m.complete(text), text
+    json.loads(text)
