@@ -1,0 +1,139 @@
+"""Realtime WebSocket API.
+
+Reference: ``src/routers/common/realtime/`` — WS proxy + WebRTC relay for
+realtime sessions (SURVEY.md §2.1).  This implements the WS transport with an
+OpenAI-realtime-style event protocol bridged onto the chat pipeline:
+
+client -> server: session.update, conversation.item.create, response.create,
+                  response.cancel
+server -> client: session.created, conversation.item.created,
+                  response.created, response.output_text.delta,
+                  response.done, error
+
+Text modality only (audio needs codec paths); conversation state is held per
+socket and fed through the same router/tool pipeline as /v1/chat/completions.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+
+from aiohttp import WSMsgType, web
+
+from smg_tpu.protocols.openai import ChatCompletionRequest, ChatMessage, StreamOptions
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.realtime")
+
+
+async def handle_realtime(request: web.Request) -> web.WebSocketResponse:
+    ctx = request.app["ctx"]
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+
+    session_id = f"sess_{uuid.uuid4().hex[:16]}"
+    session = {
+        "id": session_id,
+        "model": request.query.get("model", "default"),
+        "instructions": None,
+        "temperature": None,
+        "max_output_tokens": None,
+    }
+    history: list[ChatMessage] = []
+    await ws.send_json({"type": "session.created", "session": dict(session)})
+
+    async for msg in ws:
+        if msg.type != WSMsgType.TEXT:
+            if msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+            continue
+        try:
+            event = json.loads(msg.data)
+        except json.JSONDecodeError:
+            await ws.send_json({"type": "error", "error": {"message": "invalid JSON"}})
+            continue
+        etype = event.get("type")
+
+        if etype == "session.update":
+            patch = event.get("session", {})
+            for k in ("model", "instructions", "temperature", "max_output_tokens"):
+                if k in patch:
+                    session[k] = patch[k]
+            await ws.send_json({"type": "session.updated", "session": dict(session)})
+
+        elif etype == "conversation.item.create":
+            item = event.get("item", {})
+            role = item.get("role", "user")
+            content = item.get("content", [])
+            if isinstance(content, list):
+                text = "".join(
+                    c.get("text", "") for c in content
+                    if isinstance(c, dict) and c.get("type") in ("input_text", "text")
+                )
+            else:
+                text = str(content)
+            history.append(ChatMessage(role=role, content=text))
+            await ws.send_json({
+                "type": "conversation.item.created",
+                "item": {"id": f"item_{uuid.uuid4().hex[:12]}", "role": role},
+            })
+
+        elif etype == "response.create":
+            await _run_response(ctx, ws, session, history)
+
+        elif etype == "response.cancel":
+            # responses run to completion within _run_response; nothing pending
+            await ws.send_json({"type": "response.cancelled"})
+
+        else:
+            await ws.send_json({
+                "type": "error",
+                "error": {"message": f"unknown event type {etype!r}"},
+            })
+    return ws
+
+
+async def _run_response(ctx, ws, session: dict, history: list[ChatMessage]) -> None:
+    from smg_tpu.gateway.router import RouteError
+
+    rid = f"resp_{uuid.uuid4().hex[:16]}"
+    messages = list(history)
+    if session.get("instructions"):
+        messages.insert(0, ChatMessage(role="system", content=session["instructions"]))
+    req = ChatCompletionRequest(
+        model=session.get("model") or "default",
+        messages=messages,
+        temperature=session.get("temperature"),
+        max_tokens=session.get("max_output_tokens"),
+        stream=True,
+        stream_options=StreamOptions(include_usage=True),
+    )
+    await ws.send_json({"type": "response.created", "response": {"id": rid}})
+    parts: list[str] = []
+    usage = None
+    try:
+        async for chunk in ctx.router.chat_stream(req, request_id=rid):
+            if chunk.usage is not None:
+                usage = {
+                    "input_tokens": chunk.usage.prompt_tokens,
+                    "output_tokens": chunk.usage.completion_tokens,
+                }
+                continue
+            for ch in chunk.choices:
+                if ch.delta.content:
+                    parts.append(ch.delta.content)
+                    await ws.send_json({
+                        "type": "response.output_text.delta",
+                        "response_id": rid,
+                        "delta": ch.delta.content,
+                    })
+    except RouteError as e:
+        await ws.send_json({"type": "error", "error": {"message": e.message}})
+        return
+    text = "".join(parts)
+    history.append(ChatMessage(role="assistant", content=text))
+    await ws.send_json({
+        "type": "response.done",
+        "response": {"id": rid, "output_text": text, "usage": usage},
+    })

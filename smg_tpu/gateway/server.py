@@ -227,6 +227,9 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_post("/parse/reasoning", h_parse_reasoning)
     app.router.add_post("/v1/tokenize", h_tokenize)
     app.router.add_post("/v1/detokenize", h_detokenize)
+    from smg_tpu.gateway.realtime import handle_realtime
+
+    app.router.add_get("/v1/realtime", handle_realtime)
     app.router.add_post("/v1/responses", h_responses_create)
     app.router.add_get("/v1/responses/{response_id}", h_responses_get)
     app.router.add_delete("/v1/responses/{response_id}", h_responses_delete)

@@ -1,0 +1,174 @@
+"""Realtime WS API against a scripted worker (no engine/jax needed —
+reference: realtime WS e2e suite, SURVEY.md §4)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import WorkerClient, WorkerStreamChunk
+from smg_tpu.gateway.workers import Worker
+
+
+class PieceTokenizer:
+    """Arbitrary text round-trips through incremental decode."""
+
+    def __init__(self):
+        self.pieces = {}
+        self._next = 10
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(self.pieces.get(int(t), "") for t in ids)
+
+    def encode(self, text, add_special_tokens=False):
+        ids = []
+        for i in range(0, len(text), 4):
+            tid = self._next
+            self._next += 1
+            self.pieces[tid] = text[i : i + 4]
+            ids.append(tid)
+        return ids
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, **_):
+        parts = [f"[{m['role']}] {m.get('content') or ''}" for m in messages]
+        if add_generation_prompt:
+            parts.append("[assistant]")
+        return " ".join(parts)
+
+
+class EchoClient(WorkerClient):
+    """Streams a fixed reply one token at a time."""
+
+    def __init__(self, tokenizer, reply="hello from the realtime engine"):
+        self.tokenizer = tokenizer
+        self.reply = reply
+        self.requests: list = []
+
+    async def generate(self, req):
+        self.requests.append(req)
+        ids = self.tokenizer.encode(self.reply)
+        for i, tid in enumerate(ids):
+            last = i == len(ids) - 1
+            yield WorkerStreamChunk(
+                rid=req.rid, token_ids=[tid], finished=last,
+                finish_reason="stop" if last else None,
+                prompt_tokens=len(req.input_ids), output_tokens=i + 1,
+            )
+
+    async def abort(self, rid):
+        return True
+
+    async def health(self):
+        return True
+
+
+@pytest.fixture(scope="module")
+def rt():
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    tok = PieceTokenizer()
+    ctx.tokenizers.register("rt-model", tok, default=True)
+    echo = EchoClient(tok)
+
+    async def _setup():
+        ctx.registry.add(Worker(worker_id="echo", client=echo, model_id="rt-model"))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=60)
+
+    tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.client, h.echo = run, tc, echo
+    yield h
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_realtime_session_flow(rt):
+    async def go():
+        ws = await rt.client.ws_connect("/v1/realtime?model=rt-model")
+        created = await ws.receive_json()
+        assert created["type"] == "session.created"
+
+        await ws.send_json({"type": "session.update",
+                            "session": {"instructions": "be brief"}})
+        updated = await ws.receive_json()
+        assert updated["session"]["instructions"] == "be brief"
+
+        await ws.send_json({
+            "type": "conversation.item.create",
+            "item": {"role": "user",
+                     "content": [{"type": "input_text", "text": "hi there"}]},
+        })
+        item = await ws.receive_json()
+        assert item["type"] == "conversation.item.created"
+
+        await ws.send_json({"type": "response.create"})
+        events = []
+        while True:
+            ev = await ws.receive_json()
+            events.append(ev)
+            if ev["type"] in ("response.done", "error"):
+                break
+        await ws.close()
+        return events
+
+    events = rt.run(go())
+    types = [e["type"] for e in events]
+    assert types[0] == "response.created"
+    assert "response.output_text.delta" in types
+    assert types[-1] == "response.done"
+    done = events[-1]
+    assert done["response"]["output_text"] == "hello from the realtime engine"
+    text = "".join(e["delta"] for e in events if e["type"] == "response.output_text.delta")
+    assert text == "hello from the realtime engine"
+
+
+def test_realtime_unknown_event(rt):
+    async def go():
+        ws = await rt.client.ws_connect("/v1/realtime")
+        await ws.receive_json()  # session.created
+        await ws.send_json({"type": "bogus.event"})
+        err = await ws.receive_json()
+        await ws.close()
+        return err
+
+    err = rt.run(go())
+    assert err["type"] == "error"
+    assert "bogus.event" in err["error"]["message"]
+
+
+def test_realtime_multi_turn_history(rt):
+    async def go():
+        ws = await rt.client.ws_connect("/v1/realtime?model=rt-model")
+        await ws.receive_json()
+        for turn in ("first question", "second question"):
+            await ws.send_json({
+                "type": "conversation.item.create",
+                "item": {"role": "user",
+                         "content": [{"type": "input_text", "text": turn}]},
+            })
+            await ws.receive_json()
+            await ws.send_json({"type": "response.create"})
+            while True:
+                ev = await ws.receive_json()
+                if ev["type"] == "response.done":
+                    break
+        await ws.close()
+        return rt.echo.requests
+
+    reqs = rt.run(go())
+    # second response's prompt must include the first assistant reply (history)
+    assert len(reqs[-1].input_ids) > len(reqs[0].input_ids)
