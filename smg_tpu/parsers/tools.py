@@ -404,6 +404,114 @@ class PythonicToolParser(ToolCallParser):
         return calls
 
 
+class MinimaxM2ToolParser(ToolCallParser):
+    """MiniMax-M2 XML-invoke dialect:
+    ``<minimax:tool_call><invoke name="f"><parameter name="k">v</parameter>
+    </invoke></minimax:tool_call>`` (reference: parsers/minimax_m2.rs)."""
+
+    name = "minimax_m2"
+    start_markers = ("<minimax:tool_call>",)
+    _invoke_re = re.compile(r'<invoke name="([^"]+)">(.*?)</invoke>', re.S)
+    _param_re = re.compile(r'<parameter name="([^"]+)">(.*?)</parameter>', re.S)
+
+    def _try_extract(self, buf):
+        end = buf.find("</minimax:tool_call>")
+        if end == -1:
+            return [], buf, False
+        block = buf[len("<minimax:tool_call>"): end]
+        rest = buf[end + len("</minimax:tool_call>"):]
+        calls = []
+        for m in self._invoke_re.finditer(block):
+            args = {}
+            for pm in self._param_re.finditer(m.group(2)):
+                val = pm.group(2).strip()
+                try:
+                    args[pm.group(1)] = json.loads(val)
+                except json.JSONDecodeError:
+                    args[pm.group(1)] = val
+            calls.append(ParsedToolCall(name=m.group(1), arguments=_json_args(args)))
+        return calls, rest, True
+
+
+class CohereToolParser(ToolCallParser):
+    """Cohere Command dialect: ``<|START_ACTION|>{"tool_name": ...,
+    "parameters": {...}}<|END_ACTION|>`` — single object or array
+    (reference: parsers/cohere.rs; tool_name->name, parameters->arguments)."""
+
+    name = "cohere"
+    start_markers = ("<|START_ACTION|>",)
+
+    def _try_extract(self, buf):
+        end = buf.find("<|END_ACTION|>")
+        if end == -1:
+            return [], buf, False
+        body = buf[len("<|START_ACTION|>"): end].strip()
+        rest = buf[end + len("<|END_ACTION|>"):]
+        obj = parse_partial(body)
+        objs = obj if isinstance(obj, list) else [obj] if obj else []
+        calls = [
+            ParsedToolCall(
+                name=o.get("tool_name", o.get("name", "")),
+                arguments=_json_args(o.get("parameters", o.get("arguments", {}))),
+            )
+            for o in objs
+            if isinstance(o, dict) and (o.get("tool_name") or o.get("name"))
+        ]
+        return calls, rest, True
+
+
+class SarashinaToolParser(ToolCallParser):
+    """Sarashina dialect: python-literal list of dicts, optionally after a
+    ``<|tool_calls|>`` marker: ``[{'name': 'f', 'arguments': {...}}]``
+    (reference: parsers/sarashina.rs; the marker is a special token usually
+    stripped in detokenization, so the bare list is also recognized)."""
+
+    name = "sarashina"
+    start_markers = ("<|tool_calls|>", "[")
+
+    def _try_extract(self, buf):
+        body = buf
+        if body.startswith("<|tool_calls|>"):
+            body = body[len("<|tool_calls|>"):].lstrip()
+            if not body:
+                return [], buf, False
+        if not body.startswith("["):
+            return [], buf, True
+        # find balanced close bracket outside strings
+        depth = 0
+        in_str: str | None = None
+        for i, ch in enumerate(body):
+            if in_str:
+                if ch == in_str and body[i - 1] != "\\":
+                    in_str = None
+                continue
+            if ch in "'\"":
+                in_str = ch
+            elif ch in "[{(":
+                depth += 1
+            elif ch in ")}]":
+                depth -= 1
+                if depth == 0:
+                    block, rest = body[: i + 1], body[i + 1:]
+                    try:
+                        objs = ast.literal_eval(block)
+                    except (ValueError, SyntaxError):
+                        return [], buf, True
+                    if not isinstance(objs, list):
+                        return [], buf, True
+                    calls = [
+                        ParsedToolCall(
+                            name=o.get("name", ""), arguments=_json_args(o.get("arguments", {}))
+                        )
+                        for o in objs
+                        if isinstance(o, dict) and o.get("name")
+                    ]
+                    if not calls:
+                        return [], buf, True
+                    return calls, rest, True
+        return [], buf, False
+
+
 class Step3ToolParser(TagBlockToolParser):
     """Step-3 dialect: steptml invoke blocks (reference: parsers/step3.rs);
     simplified to the tag-block JSON form used by its chat template."""
@@ -424,6 +532,9 @@ _PARSERS: dict[str, type[ToolCallParser]] = {
         KimiK2ToolParser,
         Glm4MoeToolParser,
         PythonicToolParser,
+        MinimaxM2ToolParser,
+        CohereToolParser,
+        SarashinaToolParser,
         Step3ToolParser,
         PassthroughToolParser,
     )
@@ -444,6 +555,10 @@ _MODEL_MAP = [
     ("glm4", "glm4_moe"),
     ("step-3", "step3"),
     ("step3", "step3"),
+    ("minimax", "minimax_m2"),
+    ("command-a", "cohere"),
+    ("cohere", "cohere"),
+    ("sarashina", "sarashina"),
 ]
 
 

@@ -188,3 +188,41 @@ def test_json_like_text_without_name_is_text():
     normal, calls = p.parse_full('the object {"key": "value"} is not a call')
     assert calls == []
     assert '{"key": "value"}' in normal
+
+
+def test_minimax_m2_parser():
+    p = get_tool_parser("minimax-m2")
+    text = ('before <minimax:tool_call><invoke name="get_weather">'
+            '<parameter name="city">"Paris"</parameter>'
+            '<parameter name="days">3</parameter>'
+            '</invoke></minimax:tool_call> after')
+    normal, calls = p.parse_full(text)
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris", "days": 3}
+    assert "before" in normal and "after" in normal
+
+
+def test_cohere_parser():
+    p = get_tool_parser("command-a-03-2025")
+    text = ('<|START_RESPONSE|>On it.<|END_RESPONSE|>\n<|START_ACTION|>\n'
+            '[{"tool_name": "search", "parameters": {"q": "rust"}},\n'
+            ' {"tool_name": "get_weather", "parameters": {"city": "Paris"}}]\n'
+            '<|END_ACTION|>')
+    normal, calls = p.parse_full(text)
+    assert [c.name for c in calls] == ["search", "get_weather"]
+    assert json.loads(calls[0].arguments) == {"q": "rust"}
+
+
+def test_sarashina_parser():
+    p = get_tool_parser("sarashina2-70b")
+    for text in (
+        "<|tool_calls|>[{'name': 'get_weather', 'arguments': {'city': 'Tokyo'}}]",
+        "[{'name': 'get_weather', 'arguments': {'city': 'Tokyo'}}]",
+    ):
+        _, calls = p.parse_full(text)
+        assert len(calls) == 1, text
+        assert calls[0].name == "get_weather"
+        assert json.loads(calls[0].arguments) == {"city": "Tokyo"}
+    # plain list text is not a call
+    normal, calls = p.parse_full("[1, 2, 3] is a list")
+    assert calls == []
