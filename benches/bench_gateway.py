@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Gateway-path microbenchmarks (no TPU needed).
+
+Reference: ``model_gateway/benches/`` criterion microbenches — radix_tree,
+tool_parser, scheduler admission, policy selection (SURVEY.md §4 tier 5).
+Prints one JSON line per bench: {"bench": ..., "ops_per_sec": ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def timeit(name: str, fn, n: int, setup_each=None) -> None:
+    # warmup
+    for _ in range(min(n // 10, 100)):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    dt = time.perf_counter() - t0
+    print(json.dumps({"bench": name, "ops_per_sec": round(n / dt), "n": n}))
+
+
+def bench_radix_trees() -> None:
+    from smg_tpu.kv_index import RadixTree
+    from smg_tpu.kv_index.native import NativeRadixTree, native_available
+
+    rng = random.Random(0)
+    seqs = []
+    for _ in range(2000):
+        base = seqs[rng.randrange(len(seqs))][:rng.randrange(1, 64)] if seqs and rng.random() < 0.6 else []
+        seqs.append(base + [rng.randrange(32000) for _ in range(rng.randrange(16, 256))])
+
+    impls = [("radix_py", RadixTree())]
+    if native_available():
+        impls.append(("radix_native", NativeRadixTree()))
+    for name, tree in impls:
+        for i, s in enumerate(seqs):
+            tree.insert(s, f"w{i % 8}")
+        it = iter(range(10**9))
+        timeit(
+            f"{name}_prefix_match",
+            lambda: tree.prefix_match(seqs[next(it) % len(seqs)]),
+            5000,
+        )
+        it2 = iter(range(10**9))
+        timeit(
+            f"{name}_insert",
+            lambda: tree.insert(seqs[next(it2) % len(seqs)], "w9"),
+            5000,
+        )
+
+
+def bench_tool_parser() -> None:
+    from smg_tpu.parsers import get_tool_parser
+
+    text = 'thinking... <tool_call>\n{"name": "search", "arguments": {"q": "jax tpu"}}\n</tool_call> done'
+    timeit("tool_parse_qwen_full", lambda: get_tool_parser("qwen").parse_full(text), 5000)
+    plain = "a perfectly normal response without any tool calls in it " * 5
+
+    def stream_plain():
+        p = get_tool_parser("qwen")
+        for i in range(0, len(plain), 8):
+            p.feed(plain[i : i + 8])
+        p.flush()
+
+    timeit("tool_parse_qwen_stream_plain", stream_plain, 2000)
+
+
+def bench_reasoning_parser() -> None:
+    from smg_tpu.parsers import get_reasoning_parser
+
+    text = "<think>" + "reasoning " * 50 + "</think>" + "answer " * 20
+
+    def run():
+        p = get_reasoning_parser("qwen3")
+        for i in range(0, len(text), 16):
+            p.feed(text[i : i + 16])
+        p.flush()
+
+    timeit("reasoning_parse_stream", run, 2000)
+
+
+def bench_policies() -> None:
+    from dataclasses import dataclass
+
+    from smg_tpu.policies import RequestContext, get_policy
+
+    @dataclass
+    class W:
+        worker_id: str
+        model_id: str = "m"
+        load: int = 0
+
+        def is_available(self):
+            return True
+
+    workers = [W(f"w{i}") for i in range(16)]
+    rng = random.Random(0)
+    prompts = [[rng.randrange(32000) for _ in range(256)] for _ in range(100)]
+    for name in ("round_robin", "least_load", "power_of_two", "consistent_hashing", "cache_aware"):
+        p = get_policy(name)
+        it = iter(range(10**9))
+        timeit(
+            f"policy_{name}",
+            lambda: p.select_worker(
+                workers, RequestContext(token_ids=prompts[next(it) % 100], routing_key="k")
+            ),
+            3000,
+        )
+
+
+def bench_json_fsm() -> None:
+    from smg_tpu.constrained import JsonMachine
+
+    m = JsonMachine()
+    doc = json.dumps({"a": [1, 2, {"b": "c" * 50}], "d": True})
+    timeit("json_fsm_accepts", lambda: m.accepts(doc[: len(doc) // 2]), 10000)
+
+
+if __name__ == "__main__":
+    bench_radix_trees()
+    bench_tool_parser()
+    bench_reasoning_parser()
+    bench_policies()
+    bench_json_fsm()
