@@ -1,7 +1,7 @@
 """Benchmark: decode throughput of the in-tree TPU engine.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Measures steady-state decode tokens/sec/chip through the full engine
 (continuous-batching scheduler + paged KV + fused sampling) on a
@@ -12,21 +12,71 @@ SURVEY.md §0 "workers only see token IDs").
 Baseline: the reference's CI-gated e2e floor is 12 output tok/s per request
 stream (BASELINE.md, `test_regular_perf.py:27`) with ~32 concurrent requests
 per H100 worker => ~384 tok/s/GPU floor.  vs_baseline = value / 384.
-On non-TPU hosts this still runs (tiny model) but reports the TPU metric name
-with a "cpu-smoke" suffix so results are never confused.
+
+Robustness (the round-1 lesson): this host carries an always-on remote-TPU
+PJRT plugin registered by an ambient sitecustomize that, when its tunnel is
+wedged, makes ``import jax``/``jax.devices()`` hang or raise for EVERY
+process that inherits the ambient environment.  So the __main__ guard is an
+orchestrator that never imports jax itself: it probes the backend in a
+throwaway subprocess with a hard timeout (one retry — the tunnel
+occasionally drops a request), then runs the real benchmark in a child
+process either on TPU (ambient env, probe proved it healthy) or on CPU
+(sanitized env: sitecustomize entry stripped from PYTHONPATH, plugin's
+trigger env var removed, JAX_PLATFORMS=cpu).  A TPU child that dies or
+stalls mid-run falls back to the CPU child, so a JSON line is always
+emitted with rc=0.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import numpy as np
+# v5e HBM bandwidth, bytes/sec — roofline denominator for the utilization
+# metric (decode is memory-bound: each model step re-reads the weights and
+# the active KV pages).
+_HBM_BYTES_PER_SEC = {"tpu": 819e9, "cpu": None}
+_BASELINE_TOK_S = 384.0  # reference CI floor: 12 tok/s/stream x 32 streams
 
 
-def main() -> None:
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+# single source of truth for env sanitation lives next to the other driver
+# entry point; both files sit at the repo root so this import always resolves
+from __graft_entry__ import _repo_root, _sanitized_env  # noqa: E402
+
+
+def _probe_tpu(timeouts: tuple = (120.0, 60.0)) -> bool:
+    """True iff a TPU backend initializes in a subprocess within bounds."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "print('PLATFORMS:' + ','.join(sorted({d.platform for d in ds})))"
+    )
+    for timeout_s in timeouts:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                cwd=_repo_root(),
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if r.returncode == 0 and "tpu" in r.stdout:
+            return True
+    return False
+
+
+def main(on_tpu: bool) -> None:
+    import jax
+    import numpy as np
+
+    if not on_tpu:
+        # belt-and-braces: pin default device to CPU even if some other
+        # backend slipped through
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
     from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
     from smg_tpu.engine.engine import Engine
@@ -64,47 +114,100 @@ def main() -> None:
     engine = Engine(cfg)
 
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(10, model_cfg.vocab_size - 10, prompt_len).tolist() for _ in range(batch)]
+    prompts = [
+        rng.integers(10, model_cfg.vocab_size - 10, prompt_len).tolist()
+        for _ in range(batch)
+    ]
     sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len, ignore_eos=True)
 
     def run_round(tag: str) -> tuple[float, int]:
         finished = set()
 
-        def cb(out, rid_box=[None]):
+        def cb(out):
             if out.finished:
                 finished.add(out.rid)
 
         for i, p in enumerate(prompts):
             engine.submit(p, sp, rid=f"{tag}-{i}", on_output=cb)
-        # prefill phase (admission happens inside step)
         t0 = time.perf_counter()
-        decode_tokens = 0
         start_decode = engine.scheduler.num_decode_tokens
         while len(finished) < batch:
             engine.step()
             if time.perf_counter() - t0 > 600:
                 raise TimeoutError(f"bench stuck: {engine.loads()}")
         dt = time.perf_counter() - t0
-        decode_tokens = engine.scheduler.num_decode_tokens - start_decode
-        return dt, decode_tokens
+        return dt, engine.scheduler.num_decode_tokens - start_decode
 
-    # warmup (compile)
-    run_round("warmup")
+    run_round("warmup")  # compile
     engine.flush_cache()
 
-    dt, decode_tokens = run_round("bench")
+    dt, _ = run_round("bench")
     total_new = batch * gen_len
     tput = total_new / dt
 
-    baseline = 384.0  # reference CI floor: 12 tok/s/stream x 32 streams per chip
+    # Roofline accounting: every model step streams the full weights from
+    # HBM plus the live KV pages of each active sequence.
+    param_bytes = sum(x.nbytes for x in jax.tree.leaves(engine.runner.params))
+    kv_itemsize = 2 if dtype == "bfloat16" else 4
+    mean_ctx = prompt_len + gen_len / 2
+    kv_bytes_per_step = (
+        batch
+        * mean_ctx
+        * model_cfg.num_layers
+        * model_cfg.num_kv_heads
+        * model_cfg.head_dim
+        * 2  # K and V
+        * kv_itemsize
+    )
+    steps_per_sec = tput / batch  # each model step emits `batch` tokens
+    hbm_gbps = steps_per_sec * (param_bytes + kv_bytes_per_step) / 1e9
+    peak = _HBM_BYTES_PER_SEC["tpu" if on_tpu else "cpu"]
+    hbm_util = round(hbm_gbps * 1e9 / peak, 4) if peak else None
+
     result = {
-        "metric": "decode_tokens_per_sec_per_chip" if on_tpu else "decode_tokens_per_sec_cpu_smoke",
+        "metric": "decode_tokens_per_sec_per_chip"
+        if on_tpu
+        else "decode_tokens_per_sec_cpu_smoke",
         "value": round(tput, 2),
         "unit": "tok/s",
-        "vs_baseline": round(tput / baseline, 3),
+        "vs_baseline": round(tput / _BASELINE_TOK_S, 3),
+        "hbm_gbps": round(hbm_gbps, 2),
+        "hbm_util": hbm_util,
+        "batch": batch,
+        "gen_len": gen_len,
+        "param_bytes": param_bytes,
     }
     print(json.dumps(result))
 
 
+def _run_child(mode: str, timeout_s: float) -> bool:
+    """Run the benchmark child; forward its stdout only on success so the
+    orchestrator emits exactly ONE JSON line even if a child prints a result
+    and then stalls/dies in teardown (stderr streams through for progress)."""
+    env = dict(os.environ) if mode == "tpu" else _sanitized_env()
+    env["SMG_BENCH_MODE"] = mode
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            cwd=_repo_root(),
+            timeout=timeout_s,
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode == 0 and r.stdout:
+        sys.stdout.write(r.stdout)
+        return True
+    return False
+
+
 if __name__ == "__main__":
-    main()
+    mode = os.environ.get("SMG_BENCH_MODE")
+    if mode:
+        main(on_tpu=(mode == "tpu"))
+        sys.exit(0)
+    if _probe_tpu() and _run_child("tpu", timeout_s=1500):
+        sys.exit(0)
+    sys.exit(0 if _run_child("cpu", timeout_s=900) else 1)
