@@ -180,10 +180,29 @@ def main(on_tpu: bool) -> None:
     print(json.dumps(result))
 
 
+def _salvage_result(stdout) -> bool:
+    """Emit the last valid result line from a child's captured stdout, if any.
+    A child that completed its measurement but died/stalled in teardown (the
+    wedged-plugin scenario) still gets its number recorded."""
+    if not stdout:
+        return False
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    for line in reversed(stdout.splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            print(line)
+            return True
+    return False
+
+
 def _run_child(mode: str, timeout_s: float) -> bool:
-    """Run the benchmark child; forward its stdout only on success so the
-    orchestrator emits exactly ONE JSON line even if a child prints a result
-    and then stalls/dies in teardown (stderr streams through for progress)."""
+    """Run the benchmark child; forward exactly ONE JSON line from its stdout
+    (stderr streams through for progress).  Teardown stalls/crashes after the
+    result line are tolerated via _salvage_result."""
     env = dict(os.environ) if mode == "tpu" else _sanitized_env()
     env["SMG_BENCH_MODE"] = mode
     try:
@@ -195,12 +214,9 @@ def _run_child(mode: str, timeout_s: float) -> bool:
             stdout=subprocess.PIPE,
             text=True,
         )
-    except subprocess.TimeoutExpired:
-        return False
-    if r.returncode == 0 and r.stdout:
-        sys.stdout.write(r.stdout)
-        return True
-    return False
+    except subprocess.TimeoutExpired as e:
+        return _salvage_result(e.stdout)
+    return _salvage_result(r.stdout)
 
 
 if __name__ == "__main__":

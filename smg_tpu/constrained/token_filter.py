@@ -53,3 +53,8 @@ class TokenFilter:
 
     def is_finished(self, text_so_far: str) -> bool:
         return self.machine.complete(text_so_far)
+
+    def text_of(self, output_ids) -> str:
+        """Canonical generated-text view the acceptor sees (shared helper so
+        the scheduler and tests decode identically)."""
+        return self.tok.decode(list(output_ids), skip_special_tokens=True)

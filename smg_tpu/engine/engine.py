@@ -63,6 +63,7 @@ class Engine:
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.scheduler = Scheduler(self.runner, config, event_sink=self.events.publish)
         self._callbacks: dict[str, object] = {}
+        self._json_filter = None  # shared TokenFilter (piece table + mask cache)
         self._lock = threading.RLock()
         self._wakeup = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
@@ -89,12 +90,40 @@ class Engine:
             )
             if sampling.stop:
                 req.stop_checker = StopStringChecker(sampling.stop)
+        req.token_filter = self._build_token_filter(sampling)
         with self._wakeup:
             self.scheduler.add_request(req)
             if on_output is not None:
                 self._callbacks[rid] = on_output
             self._wakeup.notify_all()
         return rid
+
+    def _build_token_filter(self, sampling: SamplingParams):
+        """Install the grammar vocab-mask filter for structured output.
+
+        ``json_schema`` constrains generation to syntactically valid JSON
+        (``{}`` = any document; schema *shape* is not yet enforced on-device,
+        matching a grammar-backend-less engine).  The filter is shared across
+        requests: the piece table and text->mask cache are tokenizer-global.
+        Reference behavior: xgrammar-backed structured output in the engines
+        behind ``sglang_scheduler.proto`` SamplingParams."""
+        if sampling.json_schema is None and not sampling.regex and not sampling.ebnf:
+            return None
+        if sampling.regex or sampling.ebnf:
+            raise ValueError("regex/ebnf constrained decoding is not supported yet")
+        if self.tokenizer is None:
+            logger.warning("json_schema constraint ignored: engine has no tokenizer")
+            return None
+        if self._json_filter is None:
+            from smg_tpu.constrained import JsonMachine, TokenFilter
+
+            self._json_filter = TokenFilter(
+                self.tokenizer,
+                JsonMachine(),
+                self.config.model.vocab_size,
+                eos_token_ids=self.config.model.eos_token_ids,
+            )
+        return self._json_filter
 
     def abort(self, rid: str) -> bool:
         with self._lock:
@@ -121,7 +150,9 @@ class Engine:
         """Prefill leg: compute the prompt's KV, export pages to host, free
         them.  Returns {first_token, k, v, seq_len} (k/v: [L, n, ps, KD])."""
         with self._lock:
-            tok, pages, seq_len = self.scheduler.prefill_only(prompt_ids, sampling)
+            tok, pages, seq_len = self.scheduler.prefill_only(
+                prompt_ids, sampling, token_filter=self._build_token_filter(sampling)
+            )
             k, v = self.runner.export_pages(pages)
             self.scheduler.release_pages(pages)
         return {"first_token": tok, "k": k, "v": v, "seq_len": seq_len}
@@ -147,6 +178,7 @@ class Engine:
             )
             if sampling.stop:
                 req.stop_checker = StopStringChecker(sampling.stop)
+        req.token_filter = self._build_token_filter(sampling)
         with self._wakeup:
             pages = None
             try:

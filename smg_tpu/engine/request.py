@@ -47,6 +47,10 @@ class EngineRequest:
     # filled by the engine layer (detokenize/stop strings)
     detok: Any = None
     stop_checker: Any = None
+    # constrained decoding (grammar vocab mask) — engine-installed TokenFilter
+    token_filter: Any = None
+    # runner-side penalty slot state is current for this request's slot
+    penalty_synced: bool = False
 
     @property
     def prompt_len(self) -> int:

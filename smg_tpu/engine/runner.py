@@ -21,6 +21,7 @@ import numpy as np
 
 from smg_tpu.engine.config import EngineConfig
 from smg_tpu.engine.kv_cache import KvCacheSpec, create_kv_buffers, plan_cache
+from smg_tpu.engine.sampling import apply_penalties
 from smg_tpu.engine.sampling import sample_tokens as _sample_fast
 from smg_tpu.engine.sampling import sample_tokens_exact as _sample_exact
 from smg_tpu.models.registry import get_model
@@ -30,6 +31,25 @@ from smg_tpu.parallel.sharding import ShardingRules, logical_to_sharding, tree_s
 from smg_tpu.utils import get_logger
 
 logger = get_logger("engine.runner")
+
+
+def _pad_rows(a: np.ndarray, G: int, fill=0) -> np.ndarray:
+    """Pad a [g, V] array to [G, V] rows filled with ``fill``."""
+    a = np.asarray(a)
+    if a.shape[0] == G:
+        return a
+    out = np.full((G, a.shape[1]), fill, a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+def _pad_vec(v: np.ndarray, G: int, fill) -> np.ndarray:
+    v = np.asarray(v)
+    if v.shape[0] == G:
+        return v
+    out = np.full(G, fill, v.dtype)
+    out[: v.shape[0]] = v
+    return out
 
 
 def _pick_sampler():
@@ -108,6 +128,12 @@ class ModelRunner:
         self._rng_key = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self._step = 0
         self._compiled: dict = {}
+        # Penalty state lives on-device so the decode horizon can update it
+        # inside the scan (output counts feed back without host round trips).
+        # Lazy: most workloads never set a penalty, and the buffers are
+        # [max_batch+1, vocab] (row S is the garbage row for padded slots).
+        self._counts_buf = None  # [S+1, V] int32: per-slot output token counts
+        self._pmask_buf = None  # [S+1, V] bool: token appeared in the prompt
 
     def _resolve_attn_impl(self) -> str:
         import os
@@ -145,33 +171,76 @@ class ModelRunner:
             pass
         return None
 
+    # ---- penalty slot state ----
+
+    def _ensure_penalty_buffers(self) -> None:
+        if self._counts_buf is None:
+            S = self.config.scheduler.max_batch_size
+            V = self.model_cfg.vocab_size
+            self._counts_buf = jnp.zeros((S + 1, V), jnp.int32)
+            self._pmask_buf = jnp.zeros((S + 1, V), jnp.bool_)
+
+    def penalty_state(
+        self, prompt_ids: list[int], output_ids: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side (counts [V] int32, prompt_mask [V] bool) for a request."""
+        V = self.model_cfg.vocab_size
+        ids = np.asarray([t for t in output_ids if 0 <= t < V], np.int64)
+        counts = np.bincount(ids, minlength=V).astype(np.int32)
+        pmask = np.zeros(V, bool)
+        pmask[[t for t in prompt_ids if 0 <= t < V]] = True
+        return counts, pmask
+
+    def sync_slot_penalty_state(
+        self, slot: int, prompt_ids: list[int], output_ids: list[int]
+    ) -> None:
+        """(Re)initialize a decode slot's penalty state after admission —
+        output counts re-derived host-side so preemption/readmission stays
+        exact; thereafter counts update on-device inside the decode scan."""
+        self._ensure_penalty_buffers()
+        counts, pmask = self.penalty_state(prompt_ids, output_ids)
+        self._counts_buf = self._counts_buf.at[slot].set(jnp.asarray(counts))
+        self._pmask_buf = self._pmask_buf.at[slot].set(jnp.asarray(pmask))
+
     # ---- step function construction ----
 
     def _next_key(self):
         self._step += 1
         return jax.random.fold_in(self._rng_key, self._step)
 
-    def _prefill_fn(self, T: int, mp: int):
-        k = ("prefill", T, mp)
+    def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
+                    use_mask: bool = False):
+        k = ("prefill", T, mp, use_pen, use_mask)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
 
         def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
-                 key, temp, topk, topp, minp):
+                 key, temp, topk, topp, minp, *extra):
+            i = 0
+            if use_pen:
+                counts, pmask, freq, pres, rep = extra[:5]
+                i = 5
+            mask = extra[i] if use_mask else None
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table
             )
-            toks, lps = _pick_sampler()(logits[None], key, temp, topk, topp, minp)
+            logits = logits[None]
+            if use_pen:
+                logits = apply_penalties(logits, counts, pmask, freq, pres, rep)
+            toks, lps = _pick_sampler()(logits, key, temp, topk, topp, minp, mask=mask)
             return toks[0], lps[0], kc, vc
 
+        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0)
         if self.mesh is not None:
             r = self._replicated
+            in_sh = (self.param_shardings, r, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r)
+            in_sh = in_sh + (r,) * n_extra
             fn = jax.jit(
                 step,
-                in_shardings=(self.param_shardings, r, r, r, r,
-                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                in_shardings=in_sh,
                 out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
                 donate_argnums=(5, 6),
             )
@@ -180,28 +249,40 @@ class ModelRunner:
         self._compiled[k] = fn
         return fn
 
-    def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False):
-        k = ("prefill_batched", G, T, mp, no_ctx)
+    def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False,
+                            use_pen: bool = False, use_mask: bool = False):
+        k = ("prefill_batched", G, T, mp, no_ctx, use_pen, use_mask)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
 
         def step(params, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
-                 key, temps, topks, topps, minps):
+                 key, temps, topks, topps, minps, *extra):
+            i = 0
+            if use_pen:
+                counts, pmask, freqs, pres, reps = extra[:5]
+                i = 5
+            mask = extra[i] if use_mask else None
             logits, kc, vc = module.forward_prefill_batched(
                 params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
                 no_ctx=no_ctx,
             )
-            toks, lps = _pick_sampler()(logits, key, temps, topks, topps, minps)
+            if use_pen:
+                logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
+            toks, lps = _pick_sampler()(logits, key, temps, topks, topps, minps,
+                                        mask=mask)
             return toks, lps, kc, vc
 
+        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0)
         if self.mesh is not None:
             r = self._replicated
+            in_sh = (self.param_shardings, r, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r)
+            in_sh = in_sh + (r,) * n_extra
             fn = jax.jit(
                 step,
-                in_shardings=(self.param_shardings, r, r, r, r,
-                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                in_shardings=in_sh,
                 out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
                 donate_argnums=(5, 6),
             )
@@ -217,6 +298,8 @@ class ModelRunner:
         topks: np.ndarray,
         topps: np.ndarray,
         minps: np.ndarray,
+        pen: tuple | None = None,  # (counts [G_real,V], pmask [G_real,V], freqs, pres, reps)
+        mask: np.ndarray | None = None,  # [G_real, V] bool
     ) -> tuple[np.ndarray, np.ndarray]:
         """Prefill several single-chunk sequences in one call.
         Returns (tokens [G_real], logprobs [G_real])."""
@@ -227,6 +310,7 @@ class ModelRunner:
         t_max = max(len(c[0]) for c in chunks)
         T = self.config.scheduler.prefill_bucket(t_max)
         mp = len(chunks[0][2])
+        V = self.model_cfg.vocab_size
         tokens = np.zeros((G, T), np.int32)
         prefix_lens = np.zeros(G, np.int32)
         t_reals = np.zeros(G, np.int32)
@@ -245,8 +329,10 @@ class ModelRunner:
             ftopps[i] = topps[i]
             fminps[i] = minps[i]
         no_ctx = all(c[1] == 0 for c in chunks)
-        fn = self._prefill_batched_fn(G, T, mp, no_ctx)
-        toks, lps, self.k_cache, self.v_cache = fn(
+        fn = self._prefill_batched_fn(G, T, mp, no_ctx,
+                                      use_pen=pen is not None,
+                                      use_mask=mask is not None)
+        args = [
             self.params,
             self.inv_freq,
             jnp.asarray(tokens),
@@ -260,16 +346,35 @@ class ModelRunner:
             jnp.asarray(ftopks),
             jnp.asarray(ftopps),
             jnp.asarray(fminps),
-        )
+        ]
+        if pen is not None:
+            counts, pmask, freqs, pres, reps = pen
+            args += [
+                jnp.asarray(_pad_rows(counts, G).astype(np.int32)),
+                jnp.asarray(_pad_rows(pmask, G)),
+                jnp.asarray(_pad_vec(freqs, G, 0.0), jnp.float32),
+                jnp.asarray(_pad_vec(pres, G, 0.0), jnp.float32),
+                jnp.asarray(_pad_vec(reps, G, 1.0), jnp.float32),
+            ]
+        if mask is not None:
+            args.append(jnp.asarray(_pad_rows(mask, G, fill=True)))
+        toks, lps, self.k_cache, self.v_cache = fn(*args)
         return np.asarray(toks)[:g_real], np.asarray(lps)[:g_real]
 
-    def _decode_multi_fn(self, B: int, mp: int, N: int):
+    def _decode_multi_fn(self, B: int, mp: int, N: int,
+                         use_pen: bool = False, use_mask: bool = False):
         """N decode steps fused into one jitted lax.scan: sampled tokens feed
         back on-device, so host round trips amortize N-fold (the decisive win
         when dispatch latency rivals step compute).  Overshoot past a
         finished/stopped sequence writes to the garbage page and is trimmed
-        host-side."""
-        k = ("decode_multi", B, mp, N)
+        host-side.
+
+        ``use_pen`` threads the per-slot [S+1, V] output-count/prompt-mask
+        buffers through the scan (counts update on-device as tokens are
+        sampled, so penalties stay exact across the horizon).  ``use_mask``
+        adds a [B, V] constrained-decoding vocab mask; the scheduler forces
+        N=1 for masked batches since the mask is host-derived per token."""
+        k = ("decode_multi", B, mp, N, use_pen, use_mask)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -280,24 +385,36 @@ class ModelRunner:
         attn_impl = self.attn_impl
 
         def multi(params, inv_freq, tokens, entry_pos, kc, vc, page_tables,
-                  key, temps, topks, topps, minps):
+                  key, temps, topks, topps, minps, *extra):
+            i = 0
+            if use_pen:
+                counts_buf, pmask_buf, slot_idx, freqs, pres, reps = extra[:6]
+                i = 6
+            mask = extra[i] if use_mask else None
             keys = jax.random.split(key, N)
             cache_dtype = kc.dtype
             hk = jnp.zeros((L, B, N, KD), cache_dtype)
             hv = jnp.zeros((L, B, N, KD), cache_dtype)
+            counts0 = counts_buf[slot_idx] if use_pen else jnp.zeros((B, 0))
+            pmask = pmask_buf[slot_idx] if use_pen else None
 
             def body(carry, xs):
-                toks, hk, hv = carry
+                toks, hk, hv, counts = carry
                 j, kj = xs
                 logits, hk, hv = module.forward_decode_horizon(
                     params, cfg, inv_freq, toks, entry_pos + j, entry_pos, j,
                     kc, vc, page_tables, hk, hv, attn_impl=attn_impl,
                 )
-                new, lps = _pick_sampler()(logits, kj, temps, topks, topps, minps)
-                return (new, hk, hv), (new, lps)
+                if use_pen:
+                    logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
+                new, lps = _pick_sampler()(logits, kj, temps, topks, topps, minps,
+                                           mask=mask)
+                if use_pen:
+                    counts = counts.at[jnp.arange(B), new].add(1)
+                return (new, hk, hv, counts), (new, lps)
 
-            (_, hk, hv), (outs, lps) = jax.lax.scan(
-                body, (tokens, hk, hv), (jnp.arange(N), keys)
+            (_, hk, hv, counts), (outs, lps) = jax.lax.scan(
+                body, (tokens, hk, hv, counts0), (jnp.arange(N), keys)
             )
 
             # land the whole horizon into the donated cache in one scatter
@@ -316,19 +433,25 @@ class ModelRunner:
             vc = vc.reshape(L, P * ps, KD).at[:, dest].set(
                 vvals.astype(vc.dtype)
             ).reshape(vc.shape)
+            if use_pen:
+                counts_buf = counts_buf.at[slot_idx].set(counts)
+                return outs.T, lps.T, kc, vc, counts_buf
             return outs.T, lps.T, kc, vc  # [B, N]
 
+        n_extra = (6 if use_pen else 0) + (1 if use_mask else 0)
+        donate = (4, 5) + ((12,) if use_pen else ())
         if self.mesh is not None:
             r = self._replicated
-            fn = jax.jit(
-                multi,
-                in_shardings=(self.param_shardings, r, r, r,
-                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
-                out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
-                donate_argnums=(4, 5),
-            )
+            in_sh = (self.param_shardings, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r)
+            in_sh = in_sh + (r,) * n_extra
+            out_sh = (r, r, self.kv_sharding, self.kv_sharding)
+            if use_pen:
+                out_sh = out_sh + (r,)
+            fn = jax.jit(multi, in_shardings=in_sh, out_shardings=out_sh,
+                         donate_argnums=donate)
         else:
-            fn = jax.jit(multi, donate_argnums=(4, 5))
+            fn = jax.jit(multi, donate_argnums=donate)
         self._compiled[k] = fn
         return fn
 
@@ -342,11 +465,15 @@ class ModelRunner:
         topps: np.ndarray,
         minps: np.ndarray,
         num_steps: int,
+        pen: tuple | None = None,  # (slot_idx [B], freqs [B], pres [B], reps [B])
+        mask: np.ndarray | None = None,  # [B, V] bool
     ) -> tuple[np.ndarray, np.ndarray]:
         """Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
         B, mp = page_tables.shape
-        fn = self._decode_multi_fn(B, mp, num_steps)
-        toks, lps, self.k_cache, self.v_cache = fn(
+        use_pen = pen is not None
+        use_mask = mask is not None
+        fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask)
+        args = [
             self.params,
             self.inv_freq,
             jnp.asarray(tokens, jnp.int32),
@@ -359,7 +486,25 @@ class ModelRunner:
             jnp.asarray(topks, jnp.int32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(minps, jnp.float32),
-        )
+        ]
+        if use_pen:
+            self._ensure_penalty_buffers()
+            slot_idx, freqs, pres, reps = pen
+            args += [
+                self._counts_buf,
+                self._pmask_buf,
+                jnp.asarray(slot_idx, jnp.int32),
+                jnp.asarray(freqs, jnp.float32),
+                jnp.asarray(pres, jnp.float32),
+                jnp.asarray(reps, jnp.float32),
+            ]
+        if use_mask:
+            args.append(jnp.asarray(mask))
+        out = fn(*args)
+        if use_pen:
+            toks, lps, self.k_cache, self.v_cache, self._counts_buf = out
+        else:
+            toks, lps, self.k_cache, self.v_cache = out
         return np.asarray(toks), np.asarray(lps)
 
     def _decode_fn(self, B: int, mp: int):
@@ -402,6 +547,8 @@ class ModelRunner:
         top_k: int,
         top_p: float,
         min_p: float,
+        pen: tuple | None = None,  # (counts [V], pmask [V], freq, pres, rep) scalars
+        mask: np.ndarray | None = None,  # [V] bool
     ) -> tuple[int, float]:
         """Run one prefill chunk; returns (sampled_token, logprob)."""
         t = len(token_ids)
@@ -409,8 +556,9 @@ class ModelRunner:
         tokens = np.zeros(T, np.int32)
         tokens[:t] = token_ids
         mp = len(page_table)
-        fn = self._prefill_fn(T, mp)
-        tok, lp, self.k_cache, self.v_cache = fn(
+        fn = self._prefill_fn(T, mp, use_pen=pen is not None,
+                              use_mask=mask is not None)
+        args = [
             self.params,
             self.inv_freq,
             jnp.asarray(tokens),
@@ -424,7 +572,19 @@ class ModelRunner:
             jnp.asarray([top_k], jnp.int32),
             jnp.asarray([top_p], jnp.float32),
             jnp.asarray([min_p], jnp.float32),
-        )
+        ]
+        if pen is not None:
+            counts, pmask, freq, pres, rep = pen
+            args += [
+                jnp.asarray(counts, jnp.int32)[None],
+                jnp.asarray(pmask)[None],
+                jnp.asarray([freq], jnp.float32),
+                jnp.asarray([pres], jnp.float32),
+                jnp.asarray([rep], jnp.float32),
+            ]
+        if mask is not None:
+            args.append(jnp.asarray(mask)[None])
+        tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
 
     def decode(

@@ -30,9 +30,16 @@ def sample_tokens(
     top_k: jnp.ndarray,  # [B] int32 (-1 => disabled)
     top_p: jnp.ndarray,  # [B] (1.0 => disabled)
     min_p: jnp.ndarray,  # [B] (0.0 => disabled)
+    mask: jnp.ndarray | None = None,  # [B, V] bool: sampleable vocabulary
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (tokens [B] int32, logprobs [B] float32 of the chosen token
-    under the *unfiltered* distribution — OpenAI logprob semantics)."""
+    under the *unfiltered* distribution — OpenAI logprob semantics).
+
+    ``mask`` (grammar-constrained decoding) hard-excludes tokens before any
+    filtering; logprobs are then reported under the mask-renormalized
+    distribution, since the excluded tokens were never sampleable."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
     B, V = logits.shape
     greedy = temperature <= 0.0
     safe_temp = jnp.where(greedy, 1.0, temperature)
@@ -100,9 +107,12 @@ def sample_tokens_exact(
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
     min_p: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Full-sort reference implementation (exact for any top_k/top_p).
     Used by tests and available via SMG_EXACT_SAMPLING=1."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
     B, V = logits.shape
     greedy = temperature <= 0.0
     safe_temp = jnp.where(greedy, 1.0, temperature)

@@ -184,6 +184,22 @@ class Scheduler:
             if not admitted_any:
                 return
 
+    def _mask_for(self, req: EngineRequest) -> np.ndarray:
+        """Constrained-decoding vocab mask for the request's next token.
+        Fail-safe: a vocabulary with no valid continuation (tokenizer can't
+        spell the grammar) degrades to EOS-only so generation terminates
+        instead of sampling uniformly over NEG_INF logits."""
+        f = req.token_filter
+        m = f.allowed_mask(f.text_of(req.output_ids))
+        if not m.any():
+            m = m.copy()
+            m[list(self.config.model.eos_token_ids)] = True
+        return m
+
+    def _req_pen_state(self, req: EngineRequest) -> tuple:
+        """Host-side (counts [V], pmask [V]) snapshot for a prefill call."""
+        return self.runner.penalty_state(req.prompt_ids, req.output_ids)
+
     def _prefill_solo(
         self, req: EngineRequest, prompt: list[int], matched_tokens: int,
         outputs: list[StepOutput],
@@ -192,6 +208,14 @@ class Scheduler:
         row = self.page_tables[req.slot]
         start = matched_tokens
         sp = req.sampling
+        pen = None
+        if sp.has_penalties:
+            counts, pmask = self._req_pen_state(req)
+            pen = (counts, pmask, sp.frequency_penalty, sp.presence_penalty,
+                   sp.repetition_penalty)
+        mask = None
+        if req.token_filter is not None:
+            mask = self._mask_for(req)
         tok = lp = None
         while start < len(prompt):
             chunk = prompt[start : start + self.sched.max_prefill_tokens]
@@ -203,6 +227,8 @@ class Scheduler:
                 top_k=sp.top_k,
                 top_p=sp.top_p,
                 min_p=sp.min_p,
+                pen=pen,
+                mask=mask,
             )
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)
@@ -214,10 +240,20 @@ class Scheduler:
     ) -> None:
         """Batched prefill for a group of single-chunk prompts."""
         chunks = []
-        temps = np.zeros(len(group), np.float32)
-        topks = np.full(len(group), -1, np.int32)
-        topps = np.ones(len(group), np.float32)
-        minps = np.zeros(len(group), np.float32)
+        g = len(group)
+        V = self.runner.model_cfg.vocab_size
+        temps = np.zeros(g, np.float32)
+        topks = np.full(g, -1, np.int32)
+        topps = np.ones(g, np.float32)
+        minps = np.zeros(g, np.float32)
+        use_pen = any(r.sampling.has_penalties for r in group)
+        use_mask = any(r.token_filter is not None for r in group)
+        counts = np.zeros((g, V), np.int32) if use_pen else None
+        pmask = np.zeros((g, V), bool) if use_pen else None
+        freqs = np.zeros(g, np.float32)
+        pres = np.zeros(g, np.float32)
+        reps = np.ones(g, np.float32)
+        mask_arr = np.ones((g, V), bool) if use_mask else None
         for i, req in enumerate(group):
             prompt = req.all_token_ids
             chunk = prompt[req.cached_tokens :]
@@ -227,8 +263,19 @@ class Scheduler:
             topks[i] = sp.top_k
             topps[i] = sp.top_p
             minps[i] = sp.min_p
+            if use_pen and sp.has_penalties:
+                counts[i], pmask[i] = self._req_pen_state(req)
+                freqs[i] = sp.frequency_penalty
+                pres[i] = sp.presence_penalty
+                reps[i] = sp.repetition_penalty
+            if use_mask and req.token_filter is not None:
+                mask_arr[i] = self._mask_for(req)
             self.num_prefill_tokens += len(chunk)
-        toks, lps = self.runner.prefill_batched(chunks, temps, topks, topps, minps)
+        toks, lps = self.runner.prefill_batched(
+            chunks, temps, topks, topps, minps,
+            pen=(counts, pmask, freqs, pres, reps) if use_pen else None,
+            mask=mask_arr,
+        )
         for i, req in enumerate(group):
             req.seq_len = req.total_len
             self._accept_tokens(
@@ -250,7 +297,11 @@ class Scheduler:
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return
-        horizon = max(self.sched.decode_horizon, 1)
+        # constrained requests need a fresh host-derived vocab mask per token,
+        # so a batch containing one collapses the horizon to single-step
+        use_mask = any(r.token_filter is not None for _, r in active)
+        use_pen = any(r.sampling.has_penalties for _, r in active)
+        horizon = 1 if use_mask else max(self.sched.decode_horizon, 1)
         # ensure pages exist for the whole horizon's KV writes; may preempt
         survivors = []
         for i, req in active:
@@ -262,6 +313,8 @@ class Scheduler:
 
         B_real = len(active)
         B = self.sched.decode_bucket(B_real)
+        V = self.runner.model_cfg.vocab_size
+        S = self.sched.max_batch_size  # runner's garbage penalty-state row
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         page_tables = np.zeros((B, self.mp), np.int32)
@@ -269,6 +322,11 @@ class Scheduler:
         topks = np.full(B, -1, np.int32)
         topps = np.ones(B, np.float32)
         minps = np.zeros(B, np.float32)
+        slot_idx = np.full(B, S, np.int32)
+        freqs = np.zeros(B, np.float32)
+        pres = np.zeros(B, np.float32)
+        reps = np.ones(B, np.float32)
+        mask_arr = np.ones((B, V), bool) if use_mask else None
         for idx, (slot, req) in enumerate(active):
             tokens[idx] = req.output_ids[-1]
             positions[idx] = req.seq_len
@@ -278,12 +336,27 @@ class Scheduler:
             topks[idx] = sp.top_k
             topps[idx] = sp.top_p
             minps[idx] = sp.min_p
+            if use_pen:
+                slot_idx[idx] = slot
+                if sp.has_penalties:
+                    freqs[idx] = sp.frequency_penalty
+                    pres[idx] = sp.presence_penalty
+                    reps[idx] = sp.repetition_penalty
+                    if not req.penalty_synced:
+                        self.runner.sync_slot_penalty_state(
+                            slot, req.prompt_ids, req.output_ids
+                        )
+                        req.penalty_synced = True
+            if use_mask and req.token_filter is not None:
+                mask_arr[idx] = self._mask_for(req)
         # padded rows: positions land beyond mp*ps so writes hit the garbage page
         for idx in range(B_real, B):
             positions[idx] = self.mp * self.ps
 
         toks, lps = self.runner.decode_multi(
-            tokens, positions, page_tables, temps, topks, topps, minps, horizon
+            tokens, positions, page_tables, temps, topks, topps, minps, horizon,
+            pen=(slot_idx, freqs, pres, reps) if use_pen else None,
+            mask=mask_arr,
         )
         self.num_decode_tokens += B_real * horizon
         for idx, (slot, req) in enumerate(active):
@@ -342,6 +415,7 @@ class Scheduler:
             req.radix_node = None
         req.seq_len = 0
         req.cached_tokens = 0
+        req.penalty_synced = False  # re-derive counts on readmission
         req.status = RequestStatus.PREEMPTED
         self.waiting.appendleft(req)
 
@@ -383,16 +457,31 @@ class Scheduler:
 
     # ---- PD disaggregation (SURVEY.md §2.5: PrefillDecode routing mode) ----
 
-    def prefill_only(self, prompt_ids: list[int], sampling) -> tuple[int, list[int], int]:
+    def prefill_only(
+        self, prompt_ids: list[int], sampling, token_filter=None
+    ) -> tuple[int, list[int], int]:
         """Prefill a prompt and keep its pages allocated (no decode slot).
         Returns (first_token, pages, seq_len).  Caller must ``release_pages``.
-        Used by the prefill leg of PD disaggregation."""
+        Used by the prefill leg of PD disaggregation; ``token_filter`` and
+        penalties apply to the first sampled token exactly as in the
+        co-located prefill paths."""
         n_pages = math.ceil(len(prompt_ids) / self.ps)
         if not self._ensure_free_pages(n_pages):
             raise RuntimeError("out of KV pages for prefill-only request")
         pages = self.pool.alloc(n_pages)
         row = np.zeros(self.mp, np.int32)
         row[: len(pages)] = pages
+        pen = None
+        if sampling.has_penalties:
+            counts, pmask = self.runner.penalty_state(prompt_ids, [])
+            pen = (counts, pmask, sampling.frequency_penalty,
+                   sampling.presence_penalty, sampling.repetition_penalty)
+        mask = None
+        if token_filter is not None:
+            mask = token_filter.allowed_mask("")
+            if not mask.any():
+                mask = mask.copy()
+                mask[list(self.config.model.eos_token_ids)] = True
         start = 0
         tok = None
         while start < len(prompt_ids):
@@ -401,6 +490,7 @@ class Scheduler:
                 chunk, prefix_len=start, page_table=row,
                 temperature=sampling.temperature, top_k=sampling.top_k,
                 top_p=sampling.top_p, min_p=sampling.min_p,
+                pen=pen, mask=mask,
             )
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)

@@ -28,9 +28,16 @@ def sampling_to_proto(sp: SamplingParams) -> pb.SamplingParamsProto:
         n=sp.n,
         logprobs=sp.logprobs,
         top_logprobs=sp.top_logprobs,
+        stop=sp.stop,
     )
     if sp.seed is not None:
         msg.seed = sp.seed
+    if sp.json_schema is not None:
+        msg.json_schema = sp.json_schema
+    if sp.regex is not None:
+        msg.regex = sp.regex
+    if sp.ebnf is not None:
+        msg.ebnf = sp.ebnf
     return msg
 
 
@@ -50,6 +57,10 @@ def sampling_from_proto(msg: pb.SamplingParamsProto) -> SamplingParams:
         n=msg.n or 1,
         logprobs=msg.logprobs,
         top_logprobs=msg.top_logprobs,
+        stop=list(msg.stop),
+        json_schema=msg.json_schema if msg.HasField("json_schema") else None,
+        regex=msg.regex if msg.HasField("regex") else None,
+        ebnf=msg.ebnf if msg.HasField("ebnf") else None,
     )
 
 

@@ -38,10 +38,19 @@ class SchedulerServicer:
             loop.call_soon_threadsafe(q.put_nowait, out)
 
         rid = request.rid
-        self.engine.submit(
-            list(request.input_ids), sampling, rid=rid,
-            on_output=on_output, priority=request.priority,
-        )
+        try:
+            self.engine.submit(
+                list(request.input_ids), sampling, rid=rid,
+                on_output=on_output, priority=request.priority,
+            )
+        except ValueError as e:
+            # invalid sampling config (e.g. unsupported regex/ebnf constraint):
+            # structured terminal chunk, mirroring the sibling handlers
+            yield pb.GenerateChunk(
+                rid=rid, finished=True, finish_reason="error", error=str(e),
+                matched_stop_token=-1,
+            )
+            return
         try:
             while True:
                 out = await q.get()

@@ -387,3 +387,55 @@ def test_parse_endpoints(gateway):
     fc, rs = gateway.run(go())
     assert fc["calls"][0]["name"] == "f"
     assert rs["reasoning_text"] == "hmm" and rs["text"] == "ok"
+
+
+def test_response_format_json_reaches_engine():
+    """response_format=json_object flows gateway→worker→engine vocab mask.
+    MockTokenizer's vocabulary cannot spell JSON, so the constrained engine
+    degrades to EOS-only (fail-safe) — empty content with finish 'stop',
+    unmistakably different from the unconstrained 16-token greedy stream."""
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4,),
+        ),
+        dtype="float32",
+        model_id="tiny-test",
+    )
+    engine = Engine(cfg, tokenizer=MockTokenizer())
+
+    async def go():
+        client = InProcWorkerClient(engine)
+        ctx.registry.add(Worker(worker_id="w0", client=client, model_id="tiny-test"))
+        server = TestServer(build_app(ctx))
+        tc = TestClient(server)
+        await tc.start_server()
+        body = {
+            "model": "tiny-test",
+            "messages": [{"role": "user", "content": "w5 w6 w7"}],
+            "max_tokens": 16,
+            "temperature": 0.0,
+            "response_format": {"type": "json_object"},
+        }
+        r = await tc.post("/v1/chat/completions", json=body)
+        data = await r.json()
+        await tc.close()
+        return r.status, data
+
+    import threading
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        status, data = asyncio.run_coroutine_threadsafe(go(), loop).result(timeout=120)
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+    assert status == 200, data
+    choice = data["choices"][0]
+    assert choice["message"]["content"] == ""
+    assert choice["finish_reason"] == "stop"
