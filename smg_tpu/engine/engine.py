@@ -144,6 +144,57 @@ class Engine:
         with self._lock:
             return self.runner.embed(batches)
 
+    # ---- profiling (reference: /start_profile proxy -> engine profiler;
+    # TPU-native backend is jax.profiler's XLA/XProf trace) ----
+
+    def start_profile(
+        self,
+        output_dir: str,
+        host_tracer: bool = True,
+        python_tracer: bool = False,
+        num_steps: int = 0,
+    ) -> str:
+        """Begin a jax.profiler trace; returns the resolved trace dir.
+        ``num_steps > 0`` auto-stops the trace after that many engine steps
+        (reference StartProfileRequest.num_steps semantics)."""
+        import jax
+
+        with self._lock:
+            if getattr(self, "_profiling", False):
+                raise RuntimeError("profiler already running")
+            kwargs = {}
+            po_cls = getattr(jax.profiler, "ProfileOptions", None)
+            if po_cls is not None:
+                opts = po_cls()
+                opts.host_tracer_level = 2 if host_tracer else 0
+                opts.python_tracer_level = 1 if python_tracer else 0
+                kwargs["profiler_options"] = opts
+            try:
+                jax.profiler.start_trace(output_dir, **kwargs)
+            except TypeError:
+                if not kwargs:  # genuine signature error, not a compat gap
+                    raise
+                jax.profiler.start_trace(output_dir)
+            self._profiling = True
+            self._profile_steps_left = num_steps if num_steps > 0 else None
+        logger.info("profiler started -> %s", output_dir)
+        return output_dir
+
+    def stop_profile(self) -> None:
+        import jax
+
+        with self._lock:
+            if not getattr(self, "_profiling", False):
+                raise RuntimeError("profiler not running")
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                # trace serialization can fail (unwritable dir); never wedge
+                # the profiler state on it
+                self._profiling = False
+                self._profile_steps_left = None
+        logger.info("profiler stopped")
+
     # ---- PD disaggregation legs ----
 
     def prefill_export(self, prompt_ids: list[int], sampling: SamplingParams) -> dict:
@@ -221,6 +272,19 @@ class Engine:
             step_outs = self.scheduler.step()
             outputs = [self._postprocess(so) for so in step_outs]
             self.events.flush()
+            if getattr(self, "_profile_steps_left", None) is not None:
+                self._profile_steps_left -= 1
+                if self._profile_steps_left <= 0:
+                    try:
+                        import jax
+
+                        jax.profiler.stop_trace()
+                        logger.info("profiler stopped (step budget reached)")
+                    except Exception:
+                        logger.exception("step-bounded profiler stop failed")
+                    finally:
+                        self._profiling = False
+                        self._profile_steps_left = None
         for out in outputs:
             cb = self._callbacks.get(out.rid)
             if cb is not None:

@@ -241,6 +241,8 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_post("/v1/conversations/{conv_id}/items", h_conv_items_add)
     app.router.add_get("/get_loads", h_get_loads)
     app.router.add_post("/flush_cache", h_flush_cache)
+    app.router.add_post("/start_profile", h_start_profile)
+    app.router.add_post("/stop_profile", h_stop_profile)
     app.router.add_get("/workers", h_workers_list)
     app.router.add_post("/workers", h_workers_add)
     app.router.add_delete("/workers/{worker_id}", h_workers_remove)
@@ -702,6 +704,54 @@ async def h_flush_cache(request: web.Request) -> web.Response:
         except Exception as e:
             results[w.worker_id] = f"error: {e}"
     return web.json_response({"flushed": results})
+
+
+async def h_start_profile(request: web.Request) -> web.Response:
+    """Proxy engine profilers (reference: server.rs:897-898 -> engine
+    StartProfile; here -> jax.profiler trace on each worker)."""
+    ctx: AppContext = request.app["ctx"]
+    try:
+        body = await request.json() if request.can_read_body else {}
+    except Exception:
+        body = {}
+    output_dir = body.get("output_dir") or "/tmp/smg_profile"
+    results = {}
+    started = []
+    for w in ctx.registry.list():
+        try:
+            r = await w.client.start_profile(
+                output_dir,
+                host_tracer=bool(body.get("host_tracer", True)),
+                python_tracer=bool(body.get("python_tracer", False)),
+                num_steps=int(body.get("num_steps", 0) or 0),
+            )
+        except Exception as e:
+            r = {"ok": False, "error": str(e)}
+        results[w.worker_id] = r
+        if r.get("ok"):
+            started.append(w)
+    ok = bool(results) and all(r.get("ok") for r in results.values())
+    if not ok and started:
+        # all-or-nothing: roll back partial starts so no worker is left with
+        # an asymmetric trace running
+        for w in started:
+            try:
+                await w.client.stop_profile()
+            except Exception:
+                pass
+    return web.json_response({"ok": ok, "workers": results}, status=200 if ok else 503)
+
+
+async def h_stop_profile(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    results = {}
+    for w in ctx.registry.list():
+        try:
+            results[w.worker_id] = await w.client.stop_profile()
+        except Exception as e:
+            results[w.worker_id] = {"ok": False, "error": str(e)}
+    ok = bool(results) and all(r.get("ok") for r in results.values())
+    return web.json_response({"ok": ok, "workers": results}, status=200 if ok else 503)
 
 
 async def h_workers_list(request: web.Request) -> web.Response:

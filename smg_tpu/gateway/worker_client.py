@@ -75,6 +75,15 @@ class WorkerClient:
     async def flush_cache(self) -> bool:
         raise NotImplementedError
 
+    async def start_profile(
+        self, output_dir: str, host_tracer: bool = True,
+        python_tracer: bool = False, num_steps: int = 0,
+    ) -> dict:
+        return {"ok": False, "error": "profiling unsupported by this worker"}
+
+    async def stop_profile(self) -> dict:
+        return {"ok": False, "error": "profiling unsupported by this worker"}
+
     def subscribe_kv_events(self, callback) -> callable:
         """Register a KV-event batch callback; returns unsubscribe fn."""
         return lambda: None
@@ -182,6 +191,33 @@ class InProcWorkerClient(WorkerClient):
 
     async def flush_cache(self) -> bool:
         return self.engine.flush_cache()
+
+    async def start_profile(
+        self, output_dir: str, host_tracer: bool = True,
+        python_tracer: bool = False, num_steps: int = 0,
+    ) -> dict:
+        # engine-lock + trace setup off the event loop (step thread may hold
+        # the lock mid-device-step; matches the generate/embed offload pattern)
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None,
+                lambda: self.engine.start_profile(
+                    output_dir, host_tracer=host_tracer,
+                    python_tracer=python_tracer, num_steps=num_steps,
+                ),
+            )
+            return {"ok": True, "error": "", "output_dir": out}
+        except Exception as e:
+            return {"ok": False, "error": str(e)}
+
+    async def stop_profile(self) -> dict:
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, self.engine.stop_profile)
+            return {"ok": True, "error": ""}
+        except Exception as e:
+            return {"ok": False, "error": str(e)}
 
     def subscribe_kv_events(self, callback):
         return self.engine.events.subscribe(callback)

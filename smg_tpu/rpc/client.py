@@ -64,6 +64,16 @@ class GrpcWorkerClient(WorkerClient):
             request_serializer=pb.GeneratePrefilledRequestProto.SerializeToString,
             response_deserializer=pb.GenerateChunk.FromString,
         )
+        self._start_profile = c.unary_unary(
+            method("StartProfile"),
+            request_serializer=pb.StartProfileRequestProto.SerializeToString,
+            response_deserializer=pb.ProfileResponseProto.FromString,
+        )
+        self._stop_profile = c.unary_unary(
+            method("StopProfile"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.ProfileResponseProto.FromString,
+        )
         self._abort = c.unary_unary(
             method("Abort"),
             request_serializer=pb.AbortRequestProto.SerializeToString,
@@ -218,6 +228,25 @@ class GrpcWorkerClient(WorkerClient):
     async def flush_cache(self) -> bool:
         resp = await self._flush(pb.EmptyProto(), timeout=30)
         return resp.ok
+
+    async def start_profile(
+        self, output_dir: str, host_tracer: bool = True,
+        python_tracer: bool = False, num_steps: int = 0,
+    ) -> dict:
+        resp = await self._start_profile(
+            pb.StartProfileRequestProto(
+                output_dir=output_dir,
+                host_tracer=host_tracer,
+                python_tracer=python_tracer,
+                num_steps=num_steps,
+            ),
+            timeout=30,
+        )
+        return {"ok": resp.ok, "error": resp.error, "output_dir": resp.output_dir}
+
+    async def stop_profile(self) -> dict:
+        resp = await self._stop_profile(pb.EmptyProto(), timeout=60)
+        return {"ok": resp.ok, "error": resp.error}
 
     def subscribe_kv_events(self, callback):
         """Spawn a background task streaming KV events into ``callback``."""

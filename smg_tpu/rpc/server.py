@@ -194,6 +194,30 @@ class SchedulerServicer:
     async def FlushCache(self, request: pb.EmptyProto, context):
         return pb.FlushResponseProto(ok=self.engine.flush_cache())
 
+    async def StartProfile(self, request: pb.StartProfileRequestProto, context):
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None,
+                lambda: self.engine.start_profile(
+                    request.output_dir or "/tmp/smg_profile",
+                    host_tracer=request.host_tracer,
+                    python_tracer=request.python_tracer,
+                    num_steps=request.num_steps,
+                ),
+            )
+            return pb.ProfileResponseProto(ok=True, output_dir=out)
+        except Exception as e:
+            return pb.ProfileResponseProto(ok=False, error=str(e))
+
+    async def StopProfile(self, request: pb.EmptyProto, context):
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, self.engine.stop_profile)
+            return pb.ProfileResponseProto(ok=True)
+        except Exception as e:
+            return pb.ProfileResponseProto(ok=False, error=str(e))
+
     async def SubscribeKvEvents(self, request: pb.KvEventsRequestProto, context):
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -258,6 +282,16 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             servicer.GetModelInfo,
             request_deserializer=pb.EmptyProto.FromString,
             response_serializer=pb.ModelInfoProto.SerializeToString,
+        ),
+        "StartProfile": grpc.unary_unary_rpc_method_handler(
+            servicer.StartProfile,
+            request_deserializer=pb.StartProfileRequestProto.FromString,
+            response_serializer=pb.ProfileResponseProto.SerializeToString,
+        ),
+        "StopProfile": grpc.unary_unary_rpc_method_handler(
+            servicer.StopProfile,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.ProfileResponseProto.SerializeToString,
         ),
         "FlushCache": grpc.unary_unary_rpc_method_handler(
             servicer.FlushCache,

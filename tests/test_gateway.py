@@ -439,3 +439,37 @@ def test_response_format_json_reaches_engine():
     choice = data["choices"][0]
     assert choice["message"]["content"] == ""
     assert choice["finish_reason"] == "stop"
+
+
+def test_profile_start_stop_roundtrip(gateway, tmp_path):
+    """/start_profile begins a jax.profiler trace on every worker and
+    /stop_profile lands trace artifacts in the requested directory
+    (reference: gateway proxies engine profilers via /start_profile)."""
+    import os
+
+    trace_dir = str(tmp_path / "trace")
+
+    async def go():
+        r1 = await gateway.client.post("/start_profile", json={"output_dir": trace_dir})
+        b1 = await r1.json()
+        # profile an actual generation so the trace has device activity
+        await gateway.client.post(
+            "/v1/completions",
+            json={"model": "tiny-test", "prompt": "w5 w6 w7", "max_tokens": 4},
+        )
+        r2 = await gateway.client.post("/stop_profile")
+        b2 = await r2.json()
+        # double-stop is a structured error, not a crash
+        r3 = await gateway.client.post("/stop_profile")
+        b3 = await r3.json()
+        return (r1.status, b1), (r2.status, b2), (r3.status, b3)
+
+    (s1, b1), (s2, b2), (s3, b3) = gateway.run(go())
+    assert s1 == 200 and b1["ok"], b1
+    assert b1["workers"]["w0"]["output_dir"] == trace_dir
+    assert s2 == 200 and b2["ok"], b2
+    assert s3 == 503 and not b3["ok"]
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found += [f for f in files if f.endswith((".xplane.pb", ".json.gz", ".trace"))]
+    assert found, f"no trace artifacts under {trace_dir}"
