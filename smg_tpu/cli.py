@@ -44,6 +44,8 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
                    help="routing policy (round_robin, random, cache_aware, least_load, "
                         "power_of_two, prefix_hash, consistent_hashing, manual, bucket)")
     g.add_argument("--max-concurrent-requests", type=int, default=256)
+    g.add_argument("--kv-connector", default="auto", choices=["auto", "host", "device"],
+                   help="PD KV handoff: device-to-device jax transfer or host bytes")
     g.add_argument("--gateway-tokenizer-path", default=None, dest="gateway_tokenizer_path",
                    help="tokenizer for gateway-side text processing (launch mode)")
     g.add_argument("--mesh-port", type=int, default=None,

@@ -197,16 +197,27 @@ class Engine:
 
     # ---- PD disaggregation legs ----
 
-    def prefill_export(self, prompt_ids: list[int], sampling: SamplingParams) -> dict:
-        """Prefill leg: compute the prompt's KV, export pages to host, free
-        them.  Returns {first_token, k, v, seq_len} (k/v: [L, n, ps, KD])."""
+    def prefill_export(
+        self, prompt_ids: list[int], sampling: SamplingParams,
+        connector: str = "host",
+    ) -> dict:
+        """Prefill leg: compute the prompt's KV, export pages via the chosen
+        connector, free them.  Returns {first_token, k, v, seq_len, connector}
+        (k/v: [L, n, ps, KD] — numpy for ``host``, on-device jax.Arrays for
+        ``device``)."""
+        from smg_tpu.engine.kv_connector import get_connector
+
+        conn = get_connector(connector)
         with self._lock:
             tok, pages, seq_len = self.scheduler.prefill_only(
                 prompt_ids, sampling, token_filter=self._build_token_filter(sampling)
             )
-            k, v = self.runner.export_pages(pages)
+            k, v = conn.export(self.runner, pages)
             self.scheduler.release_pages(pages)
-        return {"first_token": tok, "k": k, "v": v, "seq_len": seq_len}
+        return {
+            "first_token": tok, "k": k, "v": v, "seq_len": seq_len,
+            "connector": conn.name,
+        }
 
     def submit_prefilled(
         self,
@@ -233,8 +244,10 @@ class Engine:
         with self._wakeup:
             pages = None
             try:
+                from smg_tpu.engine.kv_connector import resolve_for_payload
+
                 pages = self.scheduler.alloc_import_pages(len(prompt_ids))
-                self.runner.import_pages(pages, k, v)
+                resolve_for_payload(k).import_(self.runner, pages, k, v)
                 adopted = self.scheduler.adopt_prefilled(req, pages, first_token)
             except Exception:
                 logger.exception("KV import failed for %s", rid)

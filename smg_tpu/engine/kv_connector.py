@@ -1,0 +1,67 @@
+"""KV handoff connectors for PD (prefill/decode) disaggregation.
+
+The reference moves prompt KV between prefill and decode workers through
+pluggable connectors (NIXL / Mooncake,
+``routers/grpc/common/stages/request_execution.rs:34-82``) precisely to avoid
+staging KV on the host.  The TPU-native analogues:
+
+- ``host``   — gather pages to host numpy and ship bytes (the portable seam:
+  works across processes/hosts over gRPC; the round-1 default).
+- ``device`` — keep the gathered pages as on-device ``jax.Array``s and land
+  them on the decode engine's devices with ``jax.device_put``; XLA routes the
+  copy over ICI (same slice) or DCN (cross-slice) with no host staging.
+  Requires both engines to be addressable from one controller (in-process
+  workers / colocated meshes).  Cross-host device transfer
+  (``jax.experimental.transfer``) slots in here as a third connector when
+  multi-controller deployments land.
+
+Connector choice is a config knob (``--kv-connector auto|host|device``);
+``auto`` picks ``device`` whenever both legs advertise support.
+"""
+
+from __future__ import annotations
+
+
+class HostKvConnector:
+    """Host-mediated bytes (serializable over gRPC)."""
+
+    name = "host"
+
+    def export(self, runner, pages: list[int]):
+        return runner.export_pages(pages)
+
+    def import_(self, runner, pages: list[int], k, v) -> None:
+        runner.import_pages(pages, k, v)
+
+
+class DeviceKvConnector:
+    """Device-to-device jax.Array handoff (ICI/DCN; no host staging)."""
+
+    name = "device"
+
+    def export(self, runner, pages: list[int]):
+        return runner.export_pages_device(pages)
+
+    def import_(self, runner, pages: list[int], k, v) -> None:
+        runner.import_pages_device(pages, k, v)
+
+
+_CONNECTORS = {c.name: c for c in (HostKvConnector(), DeviceKvConnector())}
+
+
+def get_connector(name: str):
+    try:
+        return _CONNECTORS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown kv connector {name!r}; have {sorted(_CONNECTORS)}"
+        ) from None
+
+
+def resolve_for_payload(k):
+    """Connector that can land a given KV payload (single owner of the
+    payload-type knowledge; future cross-host transfer payloads dispatch
+    here too)."""
+    import jax
+
+    return _CONNECTORS["device" if isinstance(k, jax.Array) else "host"]

@@ -73,6 +73,10 @@ class ModelRunner:
 
         world = config.parallel.world_size
         self.mesh = build_mesh(config.parallel, devices=devices) if world > 1 else None
+        # single-device engines honor an explicit device pin (PD pairs on one
+        # host, tests on virtual CPU devices): committing params + KV buffers
+        # to the device makes every jit follow them there
+        self._device = devices[0] if (devices and world == 1) else None
 
         self.inv_freq = jnp.asarray(
             rope_frequencies(
@@ -88,6 +92,8 @@ class ModelRunner:
             )
         if params is not None:
             self.params = params
+            if self._device is not None:
+                self.params = jax.device_put(self.params, self._device)
         elif self.mesh is not None:
             self.params = jax.jit(
                 partial(self.module.init_params, self.model_cfg),
@@ -95,6 +101,8 @@ class ModelRunner:
             )(key)
         else:
             self.params = jax.jit(partial(self.module.init_params, self.model_cfg))(key)
+            if self._device is not None:
+                self.params = jax.device_put(self.params, self._device)
 
         # KV cache sizing + buffers
         param_bytes = sum(x.nbytes for x in jax.tree.leaves(self.params))
@@ -111,6 +119,8 @@ class ModelRunner:
             self._replicated = logical_to_sharding((), self.mesh, self.rules)
         else:
             self._replicated = None
+            if self._device is not None:
+                kv_sharding = jax.sharding.SingleDeviceSharding(self._device)
         self.kv_sharding = kv_sharding
         self.k_cache, self.v_cache = create_kv_buffers(self.spec, kv_sharding)
         logger.info(
@@ -633,6 +643,32 @@ class ModelRunner:
         idx = jnp.asarray(pages, jnp.int32)
         self.k_cache = self.k_cache.at[:, idx].set(jnp.asarray(k, self.k_cache.dtype))
         self.v_cache = self.v_cache.at[:, idx].set(jnp.asarray(v, self.v_cache.dtype))
+
+    def export_pages_device(self, pages: "list[int]") -> tuple:
+        """Gather KV pages as on-device jax.Arrays ([L, n, ps, KD] k, v).
+
+        The gather copies into fresh arrays, so the source pages can be freed
+        immediately; the payload stays resident on this engine's devices until
+        the decode engine lands it with ``import_pages_device`` (device
+        connector, SURVEY.md §7.5 ICI/DCN KV movement)."""
+        idx = jnp.asarray(pages, jnp.int32)
+        return self.k_cache[:, idx], self.v_cache[:, idx]
+
+    def import_pages_device(self, pages: "list[int]", k, v) -> None:
+        """Land a device KV payload on this cache's devices and scatter it.
+
+        ``jax.device_put`` performs the cross-device (or cross-mesh reshard)
+        copy — ICI within a slice, DCN across slices — with no host round
+        trip, replacing the reference's NIXL/Mooncake RDMA transfer."""
+        idx = jnp.asarray(pages, jnp.int32)
+        if self.kv_sharding is not None:
+            dst = self.kv_sharding
+        else:
+            dst = next(iter(self.k_cache.devices()))
+        k = jax.device_put(k, dst)
+        v = jax.device_put(v, dst)
+        self.k_cache = self.k_cache.at[:, idx].set(k.astype(self.k_cache.dtype))
+        self.v_cache = self.v_cache.at[:, idx].set(v.astype(self.v_cache.dtype))
 
     def embed(self, batches: "list[list[int]]") -> np.ndarray:
         """Sequence embeddings for a batch of token-id lists: [n, hidden]."""

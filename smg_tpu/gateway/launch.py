@@ -97,8 +97,14 @@ async def _run_gateway(args) -> int:
     from smg_tpu.gateway.server import AppContext, build_app
     from smg_tpu.gateway.workers import Worker
 
+    from smg_tpu.gateway.router import RouterConfig
+
     ctx = AppContext(
-        policy=args.policy, max_concurrent_requests=args.max_concurrent_requests
+        policy=args.policy,
+        router_config=RouterConfig(
+            kv_connector=getattr(args, "kv_connector", "auto")
+        ),
+        max_concurrent_requests=args.max_concurrent_requests,
     )
 
     if args.command == "serve":

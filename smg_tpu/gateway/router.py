@@ -53,6 +53,9 @@ class RouteError(Exception):
 @dataclass
 class RouterConfig:
     default_max_tokens: int = 512
+    # PD KV handoff: "auto" = device-to-device whenever both legs support it,
+    # else host bytes ("host" | "device" force a connector)
+    kv_connector: str = "auto"
     max_retries: int = 3
     retry_backoff_base: float = 0.1
     retry_backoff_max: float = 2.0
@@ -221,9 +224,25 @@ class Router:
         p_worker = policy.select_worker(prefill_pool, ctx)
         if p_worker is None:
             raise RouteError(503, "no healthy prefill workers", "service_unavailable")
+
+        # Connector resolution is a capability check only — the decode worker
+        # is selected AFTER prefill so failures/load changes during a long
+        # prefill still get a fresh choice.
+        connector = self.config.kv_connector
+        if connector == "auto":
+            connector = (
+                "device"
+                if p_worker.client.supports_device_kv
+                and decode_pool
+                and all(w.client.supports_device_kv for w in decode_pool)
+                else "host"
+            )
+
         p_guard = p_worker.acquire()
         try:
-            export = await p_worker.client.prefill_export(input_ids, worker_sampling)
+            export = await p_worker.client.prefill_export(
+                input_ids, worker_sampling, connector=connector
+            )
             p_guard.release(success=True)
         except Exception as e:
             p_guard.release(success=False)
@@ -232,6 +251,16 @@ class Router:
         d_worker = policy.select_worker(decode_pool, ctx)
         if d_worker is None:
             raise RouteError(503, "no healthy decode workers", "service_unavailable")
+        if (
+            export.get("connector") == "device"
+            and not d_worker.client.supports_device_kv
+        ):
+            # a host-only decode worker joined mid-flight: degrade the payload
+            import numpy as np
+
+            export["k"] = np.asarray(export["k"])
+            export["v"] = np.asarray(export["v"])
+            export["connector"] = "host"
         d_guard = d_worker.acquire()
         finished_cleanly = False
         try:

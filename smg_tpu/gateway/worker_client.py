@@ -44,6 +44,10 @@ class WorkerStreamChunk:
 class WorkerClient:
     """Transport-agnostic worker API (async)."""
 
+    #: True when this client can hand KV over as on-device jax.Arrays
+    #: (in-proc / colocated engines — the "device" kv connector).
+    supports_device_kv = False
+
     async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
         raise NotImplementedError
         yield  # pragma: no cover
@@ -55,8 +59,8 @@ class WorkerClient:
         """batches: list[list[int]] -> list[list[float]]."""
         raise NotImplementedError
 
-    async def prefill_export(self, input_ids: list, sampling) -> dict:
-        """PD prefill leg: {first_token, k, v, seq_len} (k/v numpy)."""
+    async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
+        """PD prefill leg: {first_token, k, v, seq_len, connector}."""
         raise NotImplementedError
 
     def generate_prefilled(self, req, first_token: int, k, v):
@@ -95,6 +99,8 @@ class WorkerClient:
 class InProcWorkerClient(WorkerClient):
     """Engine in the same process.  The engine's background loop runs in its
     own thread; outputs hop onto the event loop via call_soon_threadsafe."""
+
+    supports_device_kv = True
 
     def __init__(self, engine):
         self.engine = engine
@@ -137,10 +143,13 @@ class InProcWorkerClient(WorkerClient):
         )
         return [v.tolist() for v in vecs]
 
-    async def prefill_export(self, input_ids: list, sampling) -> dict:
+    async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            None, self.engine.prefill_export, list(input_ids), sampling
+            None,
+            lambda: self.engine.prefill_export(
+                list(input_ids), sampling, connector=connector
+            ),
         )
 
     async def generate_prefilled(self, req, first_token: int, k, v):

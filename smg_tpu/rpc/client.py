@@ -131,9 +131,16 @@ class GrpcWorkerClient(WorkerClient):
         finally:
             call.cancel()
 
-    async def prefill_export(self, input_ids: list, sampling) -> dict:
+    async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
+        # gRPC is inherently host-mediated: the payload crosses the wire as
+        # bytes regardless of the requested connector
         import numpy as np
 
+        if connector == "device":
+            logger.warning(
+                "kv connector 'device' requested but %s is a gRPC transport; "
+                "staging KV via host bytes", self.url,
+            )
         resp = await self._prefill_export(
             pb.PrefillExportRequestProto(
                 rid="prefill", input_ids=input_ids, sampling=sampling_to_proto(sampling)
@@ -148,6 +155,7 @@ class GrpcWorkerClient(WorkerClient):
             "seq_len": resp.seq_len,
             "k": np.frombuffer(resp.k, dtype=resp.kv_dtype).reshape(shape),
             "v": np.frombuffer(resp.v, dtype=resp.kv_dtype).reshape(shape),
+            "connector": "host",
         }
 
     async def generate_prefilled(self, req, first_token: int, k, v):
