@@ -46,6 +46,9 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--max-concurrent-requests", type=int, default=256)
     g.add_argument("--kv-connector", default="auto", choices=["auto", "host", "device"],
                    help="PD KV handoff: device-to-device jax transfer or host bytes")
+    g.add_argument("--provider-config", default=None,
+                   help="JSON file of 3rd-party provider backends "
+                        "(openai/anthropic/gemini adapters)")
     g.add_argument("--gateway-tokenizer-path", default=None, dest="gateway_tokenizer_path",
                    help="tokenizer for gateway-side text processing (launch mode)")
     g.add_argument("--mesh-port", type=int, default=None,

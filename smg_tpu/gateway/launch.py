@@ -106,6 +106,8 @@ async def _run_gateway(args) -> int:
         ),
         max_concurrent_requests=args.max_concurrent_requests,
     )
+    if getattr(args, "provider_config", None):
+        ctx.providers.load_config(args.provider_config)
 
     if args.command == "serve":
         from smg_tpu.gateway.worker_client import InProcWorkerClient

@@ -1,0 +1,4 @@
+from smg_tpu.gateway.providers.base import ProviderAdapter, ProviderError, ProviderSpec
+from smg_tpu.gateway.providers.registry import ProviderRegistry
+
+__all__ = ["ProviderAdapter", "ProviderError", "ProviderRegistry", "ProviderSpec"]

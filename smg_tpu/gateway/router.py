@@ -256,10 +256,14 @@ class Router:
             and not d_worker.client.supports_device_kv
         ):
             # a host-only decode worker joined mid-flight: degrade the payload
+            # (device->host pull runs off the event loop — it can be tens of
+            # MB through a device transfer)
             import numpy as np
 
-            export["k"] = np.asarray(export["k"])
-            export["v"] = np.asarray(export["v"])
+            loop = asyncio.get_running_loop()
+            export["k"], export["v"] = await loop.run_in_executor(
+                None, lambda: (np.asarray(export["k"]), np.asarray(export["v"]))
+            )
             export["connector"] = "host"
         d_guard = d_worker.acquire()
         finished_cleanly = False

@@ -55,8 +55,11 @@ class AppContext:
         from smg_tpu.gateway.priority import PriorityConfig, PriorityScheduler
         from smg_tpu.gateway.rate_limit import RateLimitConfig, RateLimiter
 
+        from smg_tpu.gateway.providers import ProviderRegistry
+
         self.registry = WorkerRegistry()
         self.policies = PolicyRegistry(default=policy)
+        self.providers = ProviderRegistry()
         self.tokenizers = TokenizerRegistry()
         self.kv_monitor = KvEventMonitor(self.registry, self.policies)
         self.router = Router(self.registry, self.policies, self.tokenizers, router_config)
@@ -206,6 +209,7 @@ def build_app(ctx: AppContext) -> web.Application:
         ctx.health_monitor.stop()
         if ctx.discovery is not None:
             await ctx.discovery.aclose()
+        await ctx.providers.close()
 
     app.on_startup.append(_start_background)
     app.on_cleanup.append(_stop_background)
@@ -300,7 +304,8 @@ async def h_health_generate(request: web.Request) -> web.Response:
 
 async def h_models(request: web.Request) -> web.Response:
     ctx: AppContext = request.app["ctx"]
-    ids = ctx.registry.model_ids() or ["default"]
+    ids = list(ctx.registry.model_ids()) + ctx.providers.list_models()
+    ids = ids or ["default"]
     return web.json_response(ModelList(data=[ModelCard(id=i) for i in ids]).model_dump())
 
 
@@ -323,6 +328,9 @@ async def h_chat(request: web.Request) -> web.Response | web.StreamResponse:
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
+    adapter = ctx.providers.resolve(req.model)
+    if adapter is not None:
+        return await _chat_via_provider(request, ctx, adapter, req)
     async with ctx.semaphore:
         if not req.stream:
             resp = await ctx.router.chat(req, request_id=rid)
@@ -336,6 +344,37 @@ async def h_chat(request: web.Request) -> web.Response | web.StreamResponse:
             await sse.write(b"data: [DONE]\n\n")
         except RouteError as e:
             err = ErrorResponse(error=ErrorInfo(message=e.message, type=e.err_type))
+            await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def _chat_via_provider(request, ctx, adapter, req) -> web.Response | web.StreamResponse:
+    """3rd-party provider path (reference: routers/openai/ provider routing):
+    no gateway-side tokenization — the upstream owns templating/parsing."""
+    from smg_tpu.gateway.providers import ProviderError
+
+    async with ctx.semaphore:
+        if not req.stream:
+            try:
+                data = await adapter.chat(req)
+            except ProviderError as e:
+                return _error(502 if e.status >= 500 else e.status,
+                              f"provider error: {e.message}", "provider_error")
+            except Exception as e:
+                return _error(502, f"provider unreachable: {e}", "provider_error")
+            return web.json_response(data)
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        try:
+            async for chunk in adapter.chat_stream(req):
+                await sse.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await sse.write(b"data: [DONE]\n\n")
+        except ProviderError as e:
+            err = ErrorResponse(error=ErrorInfo(message=e.message, type="provider_error"))
+            await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
+        except Exception as e:
+            err = ErrorResponse(error=ErrorInfo(message=str(e), type="provider_error"))
             await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
         await sse.write_eof()
         return sse
