@@ -121,6 +121,9 @@ class EngineConfig:
     model_id: str = "smg-tpu-model"
     # profiling hook (reference: /start_profile proxying, common.proto:75-87)
     profile_dir: str | None = None
+    # LoRA adapter bank size (slots beyond the implicit "no adapter" slot 0;
+    # reference: Load/Unload/ListLoRAAdapter, sglang_scheduler.proto:48-62)
+    max_loras: int = 4
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

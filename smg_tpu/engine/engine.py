@@ -91,6 +91,8 @@ class Engine:
             if sampling.stop:
                 req.stop_checker = StopStringChecker(sampling.stop)
         req.token_filter = self._build_token_filter(sampling)
+        if sampling.lora_adapter:
+            req.lora_idx = self.runner.lora_index(sampling.lora_adapter)
         with self._wakeup:
             self.scheduler.add_request(req)
             if on_output is not None:
@@ -143,6 +145,58 @@ class Engine:
         """Sequence embeddings (blocks the step loop briefly)."""
         with self._lock:
             return self.runner.embed(batches)
+
+    # ---- LoRA adapters (reference: Load/Unload/ListLoRAAdapter RPCs) ----
+
+    def load_lora_adapter(
+        self, name: str, path: str | None = None, data: bytes | None = None
+    ) -> int:
+        """Install an adapter from a PEFT dir / .npz path / inline npz bytes.
+        Returns the bank slot.  In-place bank write — no recompile, and
+        in-flight requests are unaffected (their gates don't touch the slot
+        until a new request names the adapter)."""
+        import os
+
+        from smg_tpu.models import lora as lora_mod
+
+        if data is not None:
+            weights = lora_mod.load_npz(data)
+        elif path is None:
+            raise ValueError("need path or data")
+        elif os.path.isdir(path):
+            weights = lora_mod.load_peft_dir(path, self.config.model)
+        else:
+            weights = lora_mod.load_npz(path)
+        with self._lock:
+            if name in self.runner._lora_names and self._lora_slot_busy(
+                self.runner._lora_names[name]
+            ):
+                raise ValueError(
+                    f"adapter {name!r} has in-flight requests; drain before replacing"
+                )
+            return self.runner.load_lora(name, weights)
+
+    def _lora_slot_busy(self, slot: int) -> bool:
+        """True when any live request is pinned to the bank slot (the bank is
+        re-read every decode step, so swapping a busy slot would change an
+        in-flight request's weights mid-generation)."""
+        return any(
+            r.lora_idx == slot and not r.is_finished
+            for r in self.scheduler.requests.values()
+        )
+
+    def unload_lora_adapter(self, name: str) -> bool:
+        with self._lock:
+            idx = self.runner._lora_names.get(name)
+            if idx is not None and self._lora_slot_busy(idx):
+                raise ValueError(
+                    f"adapter {name!r} has in-flight requests; drain before unloading"
+                )
+            return self.runner.unload_lora(name)
+
+    def list_lora_adapters(self) -> list[str]:
+        with self._lock:
+            return self.runner.list_loras()
 
     # ---- profiling (reference: /start_profile proxy -> engine profiler;
     # TPU-native backend is jax.profiler's XLA/XProf trace) ----
@@ -241,6 +295,8 @@ class Engine:
             if sampling.stop:
                 req.stop_checker = StopStringChecker(sampling.stop)
         req.token_filter = self._build_token_filter(sampling)
+        if sampling.lora_adapter:
+            req.lora_idx = self.runner.lora_index(sampling.lora_adapter)
         with self._wakeup:
             pages = None
             try:

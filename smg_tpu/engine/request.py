@@ -51,6 +51,8 @@ class EngineRequest:
     token_filter: Any = None
     # runner-side penalty slot state is current for this request's slot
     penalty_synced: bool = False
+    # LoRA adapter bank slot applied to this request (0 = base model)
+    lora_idx: int = 0
 
     @property
     def prompt_len(self) -> int:

@@ -144,6 +144,11 @@ class ModelRunner:
         # [max_batch+1, vocab] (row S is the garbage row for padded slots).
         self._counts_buf = None  # [S+1, V] int32: per-slot output token counts
         self._pmask_buf = None  # [S+1, V] bool: token appeared in the prompt
+        # LoRA adapter bank: stacked [L, N, ...] arrays, slot 0 all-zeros
+        # ("no adapter"); loading writes a slot in place — no recompile
+        self._lora_bank = None
+        self._lora_names: dict[str, int] = {}
+        self._lora_rank = 0
 
     def _resolve_attn_impl(self) -> str:
         import os
@@ -212,6 +217,70 @@ class ModelRunner:
         self._counts_buf = self._counts_buf.at[slot].set(jnp.asarray(counts))
         self._pmask_buf = self._pmask_buf.at[slot].set(jnp.asarray(pmask))
 
+    # ---- LoRA bank (multi-adapter serving; see models/lora.py) ----
+
+    @property
+    def lora_slots(self) -> int:
+        return self.config.max_loras + 1  # slot 0 = no adapter
+
+    def lora_index(self, name: str) -> int:
+        try:
+            return self._lora_names[name]
+        except KeyError:
+            raise ValueError(f"unknown LoRA adapter {name!r}") from None
+
+    def list_loras(self) -> list[str]:
+        return sorted(self._lora_names)
+
+    def load_lora(self, name: str, weights: dict) -> int:
+        """Install (or replace) an adapter in the bank; returns its slot."""
+        from smg_tpu.models.lora import canonical_keys, validate_adapter
+
+        rank = validate_adapter(self.model_cfg, weights)
+        N = self.lora_slots
+        if self._lora_bank is None:
+            self._lora_rank = rank
+            L = self.model_cfg.num_layers
+            bank = {}
+            for key in canonical_keys():
+                shape = (L, N) + weights[key].shape[1:]
+                bank[key] = jnp.zeros(shape, jnp.float32)
+            self._lora_bank = bank
+        if rank > self._lora_rank:
+            raise ValueError(
+                f"adapter rank {rank} exceeds bank rank {self._lora_rank} "
+                f"(first-loaded adapter fixes the bank rank)"
+            )
+        idx = self._lora_names.get(name)
+        if idx is None:
+            used = set(self._lora_names.values())
+            free = [i for i in range(1, N) if i not in used]
+            if not free:
+                raise ValueError(f"LoRA bank full ({N - 1} slots)")
+            idx = free[0]
+        for key in self._lora_bank:  # canonical keys only; ignore npz extras
+            w = np.asarray(weights[key], np.float32)
+            if rank < self._lora_rank:  # zero-pad smaller ranks into the bank
+                pad = self._lora_rank - rank
+                axis = 2 if key.endswith("_a") else 1
+                pads = [(0, 0)] * w.ndim
+                pads[axis] = (0, pad)
+                w = np.pad(w, pads)
+            self._lora_bank[key] = self._lora_bank[key].at[:, idx].set(
+                jnp.asarray(w)
+            )
+        self._lora_names[name] = idx
+        logger.info("lora adapter %r -> slot %d (rank %d)", name, idx, rank)
+        return idx
+
+    def unload_lora(self, name: str) -> bool:
+        idx = self._lora_names.pop(name, None)
+        if idx is None:
+            return False
+        for key in self._lora_bank:
+            self._lora_bank[key] = self._lora_bank[key].at[:, idx].set(0.0)
+        return True
+
     # ---- step function construction ----
 
     def _next_key(self):
@@ -219,12 +288,13 @@ class ModelRunner:
         return jax.random.fold_in(self._rng_key, self._step)
 
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
-                    use_mask: bool = False):
-        k = ("prefill", T, mp, use_pen, use_mask)
+                    use_mask: bool = False, use_lora: bool = False):
+        k = ("prefill", T, mp, use_pen, use_mask, use_lora)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
+        n_slots = self.lora_slots
 
         def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
                  key, temp, topk, topp, minp, *extra):
@@ -232,9 +302,17 @@ class ModelRunner:
             if use_pen:
                 counts, pmask, freq, pres, rep = extra[:5]
                 i = 5
-            mask = extra[i] if use_mask else None
+            mask = None
+            if use_mask:
+                mask = extra[i]
+                i += 1
+            lora_bank = lora_gates = None
+            if use_lora:
+                lora_bank, lora_idx = extra[i], extra[i + 1]
+                lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
             logits, kc, vc = module.forward_prefill(
-                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table
+                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
+                lora=lora_bank, lora_gates=lora_gates,
             )
             logits = logits[None]
             if use_pen:
@@ -242,7 +320,7 @@ class ModelRunner:
             toks, lps = _pick_sampler()(logits, key, temp, topk, topp, minp, mask=mask)
             return toks[0], lps[0], kc, vc
 
-        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0)
+        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0) + (2 if use_lora else 0)
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -260,12 +338,14 @@ class ModelRunner:
         return fn
 
     def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False,
-                            use_pen: bool = False, use_mask: bool = False):
-        k = ("prefill_batched", G, T, mp, no_ctx, use_pen, use_mask)
+                            use_pen: bool = False, use_mask: bool = False,
+                            use_lora: bool = False):
+        k = ("prefill_batched", G, T, mp, no_ctx, use_pen, use_mask, use_lora)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
+        n_slots = self.lora_slots
 
         def step(params, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
                  key, temps, topks, topps, minps, *extra):
@@ -273,10 +353,17 @@ class ModelRunner:
             if use_pen:
                 counts, pmask, freqs, pres, reps = extra[:5]
                 i = 5
-            mask = extra[i] if use_mask else None
+            mask = None
+            if use_mask:
+                mask = extra[i]
+                i += 1
+            lora_bank = lora_gates = None
+            if use_lora:
+                lora_bank, lora_idx = extra[i], extra[i + 1]
+                lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
             logits, kc, vc = module.forward_prefill_batched(
                 params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
-                no_ctx=no_ctx,
+                no_ctx=no_ctx, lora=lora_bank, lora_gates=lora_gates,
             )
             if use_pen:
                 logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
@@ -284,7 +371,7 @@ class ModelRunner:
                                         mask=mask)
             return toks, lps, kc, vc
 
-        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0)
+        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0) + (2 if use_lora else 0)
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -310,6 +397,7 @@ class ModelRunner:
         minps: np.ndarray,
         pen: tuple | None = None,  # (counts [G_real,V], pmask [G_real,V], freqs, pres, reps)
         mask: np.ndarray | None = None,  # [G_real, V] bool
+        lora_idx: np.ndarray | None = None,  # [G_real] adapter slot per row
     ) -> tuple[np.ndarray, np.ndarray]:
         """Prefill several single-chunk sequences in one call.
         Returns (tokens [G_real], logprobs [G_real])."""
@@ -339,9 +427,11 @@ class ModelRunner:
             ftopps[i] = topps[i]
             fminps[i] = minps[i]
         no_ctx = all(c[1] == 0 for c in chunks)
+        use_lora = lora_idx is not None and self._lora_bank is not None
         fn = self._prefill_batched_fn(G, T, mp, no_ctx,
                                       use_pen=pen is not None,
-                                      use_mask=mask is not None)
+                                      use_mask=mask is not None,
+                                      use_lora=use_lora)
         args = [
             self.params,
             self.inv_freq,
@@ -368,11 +458,17 @@ class ModelRunner:
             ]
         if mask is not None:
             args.append(jnp.asarray(_pad_rows(mask, G, fill=True)))
+        if use_lora:
+            args += [
+                self._lora_bank,
+                jnp.asarray(_pad_vec(np.asarray(lora_idx, np.int32), G, 0)),
+            ]
         toks, lps, self.k_cache, self.v_cache = fn(*args)
         return np.asarray(toks)[:g_real], np.asarray(lps)[:g_real]
 
     def _decode_multi_fn(self, B: int, mp: int, N: int,
-                         use_pen: bool = False, use_mask: bool = False):
+                         use_pen: bool = False, use_mask: bool = False,
+                         use_lora: bool = False):
         """N decode steps fused into one jitted lax.scan: sampled tokens feed
         back on-device, so host round trips amortize N-fold (the decisive win
         when dispatch latency rivals step compute).  Overshoot past a
@@ -383,8 +479,9 @@ class ModelRunner:
         buffers through the scan (counts update on-device as tokens are
         sampled, so penalties stay exact across the horizon).  ``use_mask``
         adds a [B, V] constrained-decoding vocab mask; the scheduler forces
-        N=1 for masked batches since the mask is host-derived per token."""
-        k = ("decode_multi", B, mp, N, use_pen, use_mask)
+        N=1 for masked batches since the mask is host-derived per token.
+        ``use_lora`` adds the adapter bank + per-slot adapter indices."""
+        k = ("decode_multi", B, mp, N, use_pen, use_mask, use_lora)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -394,13 +491,22 @@ class ModelRunner:
         L = cfg.num_layers
         attn_impl = self.attn_impl
 
+        n_slots = self.lora_slots
+
         def multi(params, inv_freq, tokens, entry_pos, kc, vc, page_tables,
                   key, temps, topks, topps, minps, *extra):
             i = 0
             if use_pen:
                 counts_buf, pmask_buf, slot_idx, freqs, pres, reps = extra[:6]
                 i = 6
-            mask = extra[i] if use_mask else None
+            mask = None
+            if use_mask:
+                mask = extra[i]
+                i += 1
+            lora_bank = lora_gates = None
+            if use_lora:
+                lora_bank, lora_idx = extra[i], extra[i + 1]
+                lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
             keys = jax.random.split(key, N)
             cache_dtype = kc.dtype
             hk = jnp.zeros((L, B, N, KD), cache_dtype)
@@ -414,6 +520,7 @@ class ModelRunner:
                 logits, hk, hv = module.forward_decode_horizon(
                     params, cfg, inv_freq, toks, entry_pos + j, entry_pos, j,
                     kc, vc, page_tables, hk, hv, attn_impl=attn_impl,
+                    lora=lora_bank, lora_gates=lora_gates,
                 )
                 if use_pen:
                     logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
@@ -448,7 +555,7 @@ class ModelRunner:
                 return outs.T, lps.T, kc, vc, counts_buf
             return outs.T, lps.T, kc, vc  # [B, N]
 
-        n_extra = (6 if use_pen else 0) + (1 if use_mask else 0)
+        n_extra = (6 if use_pen else 0) + (1 if use_mask else 0) + (2 if use_lora else 0)
         donate = (4, 5) + ((12,) if use_pen else ())
         if self.mesh is not None:
             r = self._replicated
@@ -477,12 +584,14 @@ class ModelRunner:
         num_steps: int,
         pen: tuple | None = None,  # (slot_idx [B], freqs [B], pres [B], reps [B])
         mask: np.ndarray | None = None,  # [B, V] bool
+        lora_idx: np.ndarray | None = None,  # [B] adapter slot per row (0 = none)
     ) -> tuple[np.ndarray, np.ndarray]:
         """Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
         B, mp = page_tables.shape
         use_pen = pen is not None
         use_mask = mask is not None
-        fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask)
+        use_lora = lora_idx is not None and self._lora_bank is not None
+        fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask, use_lora)
         args = [
             self.params,
             self.inv_freq,
@@ -510,6 +619,8 @@ class ModelRunner:
             ]
         if use_mask:
             args.append(jnp.asarray(mask))
+        if use_lora:
+            args += [self._lora_bank, jnp.asarray(lora_idx, jnp.int32)]
         out = fn(*args)
         if use_pen:
             toks, lps, self.k_cache, self.v_cache, self._counts_buf = out
@@ -559,6 +670,7 @@ class ModelRunner:
         min_p: float,
         pen: tuple | None = None,  # (counts [V], pmask [V], freq, pres, rep) scalars
         mask: np.ndarray | None = None,  # [V] bool
+        lora_idx: int = 0,  # adapter slot (0 = none)
     ) -> tuple[int, float]:
         """Run one prefill chunk; returns (sampled_token, logprob)."""
         t = len(token_ids)
@@ -566,8 +678,9 @@ class ModelRunner:
         tokens = np.zeros(T, np.int32)
         tokens[:t] = token_ids
         mp = len(page_table)
+        use_lora = lora_idx > 0 and self._lora_bank is not None
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
-                              use_mask=mask is not None)
+                              use_mask=mask is not None, use_lora=use_lora)
         args = [
             self.params,
             self.inv_freq,
@@ -594,6 +707,8 @@ class ModelRunner:
             ]
         if mask is not None:
             args.append(jnp.asarray(mask)[None])
+        if use_lora:
+            args += [self._lora_bank, jnp.int32(lora_idx)]
         tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
 

@@ -229,6 +229,7 @@ class Scheduler:
                 min_p=sp.min_p,
                 pen=pen,
                 mask=mask,
+                lora_idx=req.lora_idx,
             )
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)
@@ -254,6 +255,8 @@ class Scheduler:
         pres = np.zeros(g, np.float32)
         reps = np.ones(g, np.float32)
         mask_arr = np.ones((g, V), bool) if use_mask else None
+        use_lora = any(r.lora_idx for r in group)
+        lora_idx = np.array([r.lora_idx for r in group], np.int32) if use_lora else None
         for i, req in enumerate(group):
             prompt = req.all_token_ids
             chunk = prompt[req.cached_tokens :]
@@ -275,6 +278,7 @@ class Scheduler:
             chunks, temps, topks, topps, minps,
             pen=(counts, pmask, freqs, pres, reps) if use_pen else None,
             mask=mask_arr,
+            lora_idx=lora_idx,
         )
         for i, req in enumerate(group):
             req.seq_len = req.total_len
@@ -301,6 +305,7 @@ class Scheduler:
         # so a batch containing one collapses the horizon to single-step
         use_mask = any(r.token_filter is not None for _, r in active)
         use_pen = any(r.sampling.has_penalties for _, r in active)
+        use_lora = any(r.lora_idx for _, r in active)
         horizon = 1 if use_mask else max(self.sched.decode_horizon, 1)
         # ensure pages exist for the whole horizon's KV writes; may preempt
         survivors = []
@@ -326,6 +331,7 @@ class Scheduler:
         freqs = np.zeros(B, np.float32)
         pres = np.zeros(B, np.float32)
         reps = np.ones(B, np.float32)
+        lora_idx = np.zeros(B, np.int32) if use_lora else None
         mask_arr = np.ones((B, V), bool) if use_mask else None
         for idx, (slot, req) in enumerate(active):
             tokens[idx] = req.output_ids[-1]
@@ -349,6 +355,8 @@ class Scheduler:
                         req.penalty_synced = True
             if use_mask and req.token_filter is not None:
                 mask_arr[idx] = self._mask_for(req)
+            if use_lora:
+                lora_idx[idx] = req.lora_idx
         # padded rows: positions land beyond mp*ps so writes hit the garbage page
         for idx in range(B_real, B):
             positions[idx] = self.mp * self.ps
@@ -357,6 +365,7 @@ class Scheduler:
             tokens, positions, page_tables, temps, topks, topps, minps, horizon,
             pen=(slot_idx, freqs, pres, reps) if use_pen else None,
             mask=mask_arr,
+            lora_idx=lora_idx,
         )
         self.num_decode_tokens += B_real * horizon
         for idx, (slot, req) in enumerate(active):

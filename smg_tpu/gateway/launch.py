@@ -65,7 +65,20 @@ def build_engine_from_args(args):
         from smg_tpu.models.weights import load_params
 
         params = load_params(cfg)
-    return Engine(cfg, params=params)
+    if cfg.tokenizer_path:
+        tokenizer = load_tokenizer(cfg.tokenizer_path)
+    else:
+        # preset models (tests/bench): a vocab-matched mock keeps worker-side
+        # detokenize/stop/constrained paths live and the GetTokenizer bundle
+        # meaningful
+        from smg_tpu.tokenizer import MockTokenizer
+
+        tokenizer = MockTokenizer(
+            vocab_size=model.vocab_size,
+            eos_token_id=(model.eos_token_ids or (0,))[0],
+            bos_token_id=model.bos_token_id if model.bos_token_id is not None else 1,
+        )
+    return Engine(cfg, params=params, tokenizer=tokenizer)
 
 
 def load_tokenizer(path: str | None):
@@ -122,13 +135,14 @@ async def _run_gateway(args) -> int:
                 page_size=engine.config.cache.page_size,
             )
         )
-    if args.command == "launch":
-        # gateway-only mode still does gateway-side tokenize/detokenize
-        tokenizer = load_tokenizer(
-            getattr(args, "gateway_tokenizer_path", None)
-            or getattr(args, "tokenizer_path", None)
-        )
+    explicit_tok = getattr(args, "gateway_tokenizer_path", None) or getattr(
+        args, "tokenizer_path", None
+    )
+    if args.command == "launch" and explicit_tok:
+        tokenizer = load_tokenizer(explicit_tok)
         ctx.tokenizers.register("default", tokenizer, default=True)
+    # an operator-configured tokenizer wins over worker bundles outright
+    fetch_bundles = not explicit_tok
 
     from smg_tpu.gateway.workers import WorkerType
 
@@ -142,12 +156,30 @@ async def _run_gateway(args) -> int:
 
         client = GrpcWorkerClient(url)
         info = await client.get_model_info()
+        model_id = info.get("model_id", "default")
         ctx.registry.add(
             Worker(
-                worker_id=url, client=client, model_id=info.get("model_id", "default"),
+                worker_id=url, client=client, model_id=model_id,
                 url=url, page_size=info.get("page_size") or None, worker_type=wtype,
             )
         )
+        # no tokenizer mirrored onto the gateway host? fetch the worker's
+        # bundle (reference: GetTokenizer at registration)
+        if fetch_bundles and not ctx.tokenizers.has(model_id):
+            try:
+                tok = await client.get_tokenizer()
+            except Exception as e:
+                logger.warning("tokenizer bundle fetch failed from %s: %s", url, e)
+                tok = None
+            if tok is not None:
+                ctx.tokenizers.register(
+                    model_id, tok, default=ctx.tokenizers.get(None) is None
+                )
+                logger.info("tokenizer for %r fetched from worker %s", model_id, url)
+
+    if args.command == "launch" and ctx.tokenizers.get(None) is None:
+        # nothing explicit and no worker handed one over: mock fallback
+        ctx.tokenizers.register("default", load_tokenizer(None), default=True)
 
     mesh_node = None
     if getattr(args, "mesh_port", None) is not None:

@@ -247,6 +247,9 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_post("/flush_cache", h_flush_cache)
     app.router.add_post("/start_profile", h_start_profile)
     app.router.add_post("/stop_profile", h_stop_profile)
+    app.router.add_post("/load_lora_adapter", h_load_lora)
+    app.router.add_post("/unload_lora_adapter", h_unload_lora)
+    app.router.add_get("/list_lora_adapters", h_list_lora)
     app.router.add_get("/workers", h_workers_list)
     app.router.add_post("/workers", h_workers_add)
     app.router.add_delete("/workers/{worker_id}", h_workers_remove)
@@ -743,6 +746,53 @@ async def h_flush_cache(request: web.Request) -> web.Response:
         except Exception as e:
             results[w.worker_id] = f"error: {e}"
     return web.json_response({"flushed": results})
+
+
+async def h_load_lora(request: web.Request) -> web.Response:
+    """Broadcast LoadLoRAAdapter to workers (reference LoRA admin surface)."""
+    ctx: AppContext = request.app["ctx"]
+    try:
+        body = await request.json()
+        name = body["lora_name"]
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    path = body.get("lora_path")
+    results = {}
+    for w in ctx.registry.list():
+        try:
+            results[w.worker_id] = await w.client.load_lora_adapter(name, path=path)
+        except Exception as e:
+            results[w.worker_id] = {"ok": False, "error": str(e)}
+    ok = bool(results) and all(r.get("ok") for r in results.values())
+    return web.json_response({"ok": ok, "workers": results}, status=200 if ok else 503)
+
+
+async def h_unload_lora(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    try:
+        body = await request.json()
+        name = body["lora_name"]
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    results = {}
+    for w in ctx.registry.list():
+        try:
+            results[w.worker_id] = await w.client.unload_lora_adapter(name)
+        except Exception as e:
+            results[w.worker_id] = {"ok": False, "error": str(e)}
+    ok = bool(results) and all(r.get("ok") for r in results.values())
+    return web.json_response({"ok": ok, "workers": results}, status=200 if ok else 503)
+
+
+async def h_list_lora(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    results = {}
+    for w in ctx.registry.list():
+        try:
+            results[w.worker_id] = await w.client.list_lora_adapters()
+        except Exception as e:
+            results[w.worker_id] = f"error: {e}"
+    return web.json_response({"workers": results})
 
 
 async def h_start_profile(request: web.Request) -> web.Response:

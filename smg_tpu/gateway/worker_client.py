@@ -88,6 +88,21 @@ class WorkerClient:
     async def stop_profile(self) -> dict:
         return {"ok": False, "error": "profiling unsupported by this worker"}
 
+    async def load_lora_adapter(
+        self, name: str, path: str | None = None, data: bytes | None = None
+    ) -> dict:
+        return {"ok": False, "error": "LoRA unsupported by this worker"}
+
+    async def unload_lora_adapter(self, name: str) -> dict:
+        return {"ok": False, "error": "LoRA unsupported by this worker"}
+
+    async def list_lora_adapters(self) -> list[str]:
+        return []
+
+    async def get_tokenizer(self):
+        """Worker's tokenizer object (bundle-fetched for remote transports)."""
+        return None
+
     def subscribe_kv_events(self, callback) -> callable:
         """Register a KV-event batch callback; returns unsubscribe fn."""
         return lambda: None
@@ -227,6 +242,30 @@ class InProcWorkerClient(WorkerClient):
             return {"ok": True, "error": ""}
         except Exception as e:
             return {"ok": False, "error": str(e)}
+
+    async def load_lora_adapter(
+        self, name: str, path: str | None = None, data: bytes | None = None
+    ) -> dict:
+        loop = asyncio.get_running_loop()
+        try:
+            slot = await loop.run_in_executor(
+                None, lambda: self.engine.load_lora_adapter(name, path=path, data=data)
+            )
+            return {"ok": True, "error": "", "slot": slot}
+        except Exception as e:
+            return {"ok": False, "error": str(e)}
+
+    async def unload_lora_adapter(self, name: str) -> dict:
+        loop = asyncio.get_running_loop()
+        ok = await loop.run_in_executor(None, self.engine.unload_lora_adapter, name)
+        return {"ok": ok, "error": "" if ok else f"adapter {name!r} not loaded"}
+
+    async def list_lora_adapters(self) -> list[str]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.engine.list_lora_adapters)
+
+    async def get_tokenizer(self):
+        return self.engine.tokenizer
 
     def subscribe_kv_events(self, callback):
         return self.engine.events.subscribe(callback)

@@ -124,11 +124,41 @@ def unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("...e,ev->...v", h, params["lm_head"]).astype(jnp.float32)
 
 
-def _qkv(layer: Params, cfg: ModelConfig, h: jnp.ndarray):
+def _lora_delta(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
+                gates: jnp.ndarray) -> jnp.ndarray:
+    """Per-token multi-adapter LoRA delta, dense one-hot dispatch.
+
+    ``x`` [..., E_in], ``a`` [N, E_in, r], ``b`` [N, r, E_out] (alpha/r scaling
+    pre-folded into b), ``gates`` [..., N] one-hot adapter selection.  Same
+    TPU-first trade as ``_moe_mlp``: compute every adapter's (tiny, rank-r)
+    delta and mask — static shapes, no routing collectives; adapter slot 0 is
+    all-zeros so un-adapted tokens pay nothing semantically (reference LoRA
+    serving: Load/Unload/ListLoRAAdapter, sglang_scheduler.proto:48-62)."""
+    t = jnp.einsum("...e,ner->...nr", x, a.astype(x.dtype))
+    d = jnp.einsum("...nr,nro->...no", t, b.astype(x.dtype))
+    return jnp.einsum("...no,...n->...o", d, gates.astype(x.dtype))
+
+
+def _qkv(layer: Params, cfg: ModelConfig, h: jnp.ndarray,
+         lora: Params | None = None, gates: jnp.ndarray | None = None):
     q = jnp.einsum("...e,ehd->...hd", h, layer["wq"])
     k = jnp.einsum("...e,ekd->...kd", h, layer["wk"])
     v = jnp.einsum("...e,ekd->...kd", h, layer["wv"])
+    if lora is not None:
+        q = q + _lora_delta(h, lora["wq_a"], lora["wq_b"], gates).reshape(q.shape)
+        k = k + _lora_delta(h, lora["wk_a"], lora["wk_b"], gates).reshape(k.shape)
+        v = v + _lora_delta(h, lora["wv_a"], lora["wv_b"], gates).reshape(v.shape)
     return q, k, v
+
+
+def _attn_out(layer: Params, attn: jnp.ndarray, lora: Params | None = None,
+              gates: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Attention output projection (+ optional LoRA delta on wo)."""
+    o = jnp.einsum("...hd,hde->...e", attn, layer["wo"])
+    if lora is not None:
+        flat = attn.reshape(*attn.shape[:-2], attn.shape[-2] * attn.shape[-1])
+        o = o + _lora_delta(flat, lora["wo_a"], lora["wo_b"], gates)
+    return o
 
 
 def _mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -173,9 +203,13 @@ def forward_prefill(
     k_cache: jnp.ndarray,  # [L, P, ps, K*D] (fused lane layout)
     v_cache: jnp.ndarray,
     page_table: jnp.ndarray,  # [mp] pages owned by this sequence
+    lora: Params | None = None,  # stacked [L, N, ...] adapter bank
+    lora_gates: jnp.ndarray | None = None,  # [N] one-hot (one sequence)
 ):
     """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache)."""
     T = tokens.shape[0]
+    if lora is not None:
+        lora_gates = jnp.broadcast_to(lora_gates, (T, lora_gates.shape[-1]))
     ps = k_cache.shape[2]
     mp = page_table.shape[0]
     scale = 1.0 / math.sqrt(cfg.head_dim)
@@ -192,23 +226,29 @@ def forward_prefill(
 
     def layer_body(carry, xs):
         h, k_cache, v_cache = carry
-        layer, l = xs
+        if lora is not None:
+            layer, lor, l = xs
+        else:
+            (layer, l), lor = xs, None
         hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn)
+        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
         k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
         k_ctx, v_ctx = gather_seq_kv(k_cache[l], v_cache[l], page_table, cfg.num_kv_heads)
         attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
-        h = h + jnp.einsum("thd,hde->te", attn, layer["wo"])
+        h = h + _attn_out(layer, attn, lor, lora_gates)
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn, cfg)
         return (h, k_cache, v_cache), None
 
+    xs = (
+        (params["layers"], lora, jnp.arange(cfg.num_layers))
+        if lora is not None
+        else (params["layers"], jnp.arange(cfg.num_layers))
+    )
     (h, k_cache, v_cache), _ = jax.lax.scan(
-        layer_body,
-        (h, k_cache, v_cache),
-        (params["layers"], jnp.arange(cfg.num_layers)),
+        layer_body, (h, k_cache, v_cache), xs
     )
     last = jnp.take_along_axis(
         h, jnp.maximum(t_real - 1, 0)[None, None].astype(jnp.int32), axis=0
@@ -226,6 +266,8 @@ def forward_decode(
     k_cache: jnp.ndarray,  # [L, P, ps, K*D] (fused lane layout)
     v_cache: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, mp]; inactive rows all-zero -> garbage page
+    lora: Params | None = None,
+    lora_gates: jnp.ndarray | None = None,  # [B, N] one-hot per slot
 ):
     """One decode step for the whole batch (compat path: XLA attention only —
     the serving hot path is ``forward_decode_horizon``); returns
@@ -249,22 +291,28 @@ def forward_decode(
     # whole cache every step (measured ~17 ms/step at 1B serving sizes).
     def layer_body(carry, xs):
         h, k_cache, v_cache = carry
-        layer, l = xs
+        if lora is not None:
+            layer, lor, l = xs
+        else:
+            (layer, l), lor = xs, None
         hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn)  # q: [B, H, D]
+        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # q: [B, H, D]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
         k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
         attn = attention_decode(q, k_cache[l], v_cache[l], page_tables, positions, scale)
-        h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
+        h = h + _attn_out(layer, attn, lor, lora_gates)
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn, cfg)
         return (h, k_cache, v_cache), None
 
+    xs = (
+        (params["layers"], lora, jnp.arange(cfg.num_layers))
+        if lora is not None
+        else (params["layers"], jnp.arange(cfg.num_layers))
+    )
     (h, k_cache, v_cache), _ = jax.lax.scan(
-        layer_body,
-        (h, k_cache, v_cache),
-        (params["layers"], jnp.arange(cfg.num_layers)),
+        layer_body, (h, k_cache, v_cache), xs
     )
     logits = unembed(params, cfg, h)  # [B, V]
     return logits, k_cache, v_cache
@@ -281,6 +329,8 @@ def forward_prefill_batched(
     v_cache: jnp.ndarray,
     page_tables: jnp.ndarray,  # [G, mp]
     no_ctx: bool = False,  # static: all rows cold (prefix 0, single chunk)
+    lora: Params | None = None,
+    lora_gates: jnp.ndarray | None = None,  # [G, N] one-hot per sequence
 ):
     """Prefill several sequences in one device call (fills the MXU and
     amortizes dispatch; single-sequence prefill wastes both).  Returns
@@ -304,12 +354,20 @@ def forward_prefill_batched(
     ctx_lens = prefix_lens + t_reals
 
     h = embed_tokens(params, cfg, tokens)  # [G, T, E]
+    if lora is not None:
+        # per-sequence gate broadcast across the row's tokens
+        lora_gates = jnp.broadcast_to(
+            lora_gates[:, None, :], (G_, T, lora_gates.shape[-1])
+        )
 
     def layer_body(carry, xs):
         h, k_cache, v_cache = carry
-        layer, l = xs
+        if lora is not None:
+            layer, lor, l = xs
+        else:
+            (layer, l), lor = xs, None
         hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn)  # [G, T, H/K, D]
+        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [G, T, H/K, D]
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
         k_cache, v_cache = scatter_kv_pages_full(
@@ -325,15 +383,18 @@ def forward_prefill_batched(
             k_ctx = kl.reshape(G_, S, K, D)
             v_ctx = vl.reshape(G_, S, K, D)
             attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale)
-        h = h + jnp.einsum("gthd,hde->gte", attn, layer["wo"])
+        h = h + _attn_out(layer, attn, lor, lora_gates)
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn, cfg)
         return (h, k_cache, v_cache), None
 
+    xs = (
+        (params["layers"], lora, jnp.arange(cfg.num_layers))
+        if lora is not None
+        else (params["layers"], jnp.arange(cfg.num_layers))
+    )
     (h, k_cache, v_cache), _ = jax.lax.scan(
-        layer_body,
-        (h, k_cache, v_cache),
-        (params["layers"], jnp.arange(cfg.num_layers)),
+        layer_body, (h, k_cache, v_cache), xs
     )
     last_idx = jnp.maximum(t_reals - 1, 0)[:, None, None]  # [G, 1, 1]
     last = jnp.take_along_axis(
@@ -357,6 +418,8 @@ def forward_decode_horizon(
     hk_all: jnp.ndarray,  # [L, B, N, K*D] horizon side buffers (carried)
     hv_all: jnp.ndarray,
     attn_impl: str = "xla",
+    lora: Params | None = None,
+    lora_gates: jnp.ndarray | None = None,  # [B, n_adapters] one-hot per slot
 ):
     """One decode step against a frozen cache + growing side buffer.
 
@@ -374,9 +437,12 @@ def forward_decode_horizon(
 
     def layer_body(carry, xs):
         h, hk_all, hv_all = carry
-        layer, l = xs
+        if lora is not None:
+            layer, lor, l = xs
+        else:
+            (layer, l), lor = xs, None
         hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn)  # [B, H/K, D]
+        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [B, H/K, D]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
         k_f = k.reshape(B, K * D).astype(hk_all.dtype)
@@ -401,15 +467,18 @@ def forward_decode_horizon(
                 q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
                 page_tables, entry_positions, scale,
             )
-        h = h + jnp.einsum("bhd,hde->be", attn, layer["wo"])
+        h = h + _attn_out(layer, attn, lor, lora_gates)
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn, cfg)
         return (h, hk_all, hv_all), None
 
+    xs = (
+        (params["layers"], lora, jnp.arange(cfg.num_layers))
+        if lora is not None
+        else (params["layers"], jnp.arange(cfg.num_layers))
+    )
     (h, hk_all, hv_all), _ = jax.lax.scan(
-        layer_body,
-        (h, hk_all, hv_all),
-        (params["layers"], jnp.arange(cfg.num_layers)),
+        layer_body, (h, hk_all, hv_all), xs
     )
     logits = unembed(params, cfg, h)
     return logits, hk_all, hv_all

@@ -1,0 +1,112 @@
+"""LoRA adapter loading + bank management for multi-adapter serving.
+
+Reference capability: ``Load/Unload/ListLoRAAdapter`` RPCs
+(``sglang_scheduler.proto:48-62``).  TPU-native serving design: adapters live
+in a fixed-size **bank** of stacked arrays ``[L, N, ...]`` (L layers, N
+adapter slots, slot 0 all-zeros = "no adapter"), and the forward pass applies
+all adapters densely with a per-token one-hot gate (``llama._lora_delta``) —
+static shapes, batch-mixable adapters, no recompile on load/unload: loading
+writes a bank slot in place.
+
+Canonical adapter layout (per target projection p in wq/wk/wv/wo):
+``{p}_a`` [L, E_in, r] and ``{p}_b`` [L, r, E_out] with the PEFT
+``alpha / r`` scaling pre-folded into ``b``.  Loaders accept:
+
+- an ``.npz`` file / bytes in canonical layout (tests, custom tooling);
+- a HF PEFT directory: ``adapter_config.json`` + ``adapter_model.safetensors``
+  with ``...layers.{i}.self_attn.{q,k,v,o}_proj.lora_{A,B}.weight`` entries.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import numpy as np
+
+_PROJ_DIMS = {
+    # proj -> (in_dim_fn, out_dim_fn)
+    "wq": (lambda c: c.hidden_size, lambda c: c.num_heads * c.head_dim),
+    "wk": (lambda c: c.hidden_size, lambda c: c.num_kv_heads * c.head_dim),
+    "wv": (lambda c: c.hidden_size, lambda c: c.num_kv_heads * c.head_dim),
+    "wo": (lambda c: c.num_heads * c.head_dim, lambda c: c.hidden_size),
+}
+_PEFT_NAMES = {"q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo"}
+
+
+def canonical_keys() -> list[str]:
+    return [f"{p}_{ab}" for p in _PROJ_DIMS for ab in ("a", "b")]
+
+
+def empty_adapter(cfg, rank: int) -> dict[str, np.ndarray]:
+    L = cfg.num_layers
+    out = {}
+    for p, (fin, fout) in _PROJ_DIMS.items():
+        out[f"{p}_a"] = np.zeros((L, fin(cfg), rank), np.float32)
+        out[f"{p}_b"] = np.zeros((L, rank, fout(cfg)), np.float32)
+    return out
+
+
+def validate_adapter(cfg, weights: dict) -> int:
+    """Check canonical-layout shapes; returns the adapter rank."""
+    rank = None
+    for p, (fin, fout) in _PROJ_DIMS.items():
+        a, b = weights.get(f"{p}_a"), weights.get(f"{p}_b")
+        if a is None or b is None:
+            raise ValueError(f"adapter missing {p}_a/{p}_b")
+        L, ein, r = a.shape
+        if L != cfg.num_layers or ein != fin(cfg):
+            raise ValueError(f"{p}_a shape {a.shape} mismatches model")
+        if b.shape != (cfg.num_layers, r, fout(cfg)):
+            raise ValueError(f"{p}_b shape {b.shape} mismatches model/rank")
+        if rank is None:
+            rank = r
+        elif r != rank:
+            raise ValueError("mixed ranks across projections unsupported")
+    return int(rank)
+
+
+def load_npz(data: bytes | str) -> dict[str, np.ndarray]:
+    if isinstance(data, (bytes, bytearray)):
+        f = np.load(io.BytesIO(bytes(data)))
+    else:
+        f = np.load(data)
+    return {k: np.asarray(f[k], np.float32) for k in f.files}
+
+
+def load_peft_dir(path: str, cfg) -> dict[str, np.ndarray]:
+    """HF PEFT directory -> canonical stacked layout (scaling folded in)."""
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    rank = int(acfg.get("r", 8))
+    alpha = float(acfg.get("lora_alpha", rank))
+    scaling = alpha / rank
+
+    tensors: dict[str, np.ndarray] = {}
+    st_path = os.path.join(path, "adapter_model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+
+        tensors = load_file(st_path)
+    else:  # npz fallback inside a PEFT-style dir
+        npz_path = os.path.join(path, "adapter_model.npz")
+        tensors = dict(np.load(npz_path))
+
+    out = empty_adapter(cfg, rank)
+    for key, val in tensors.items():
+        parts = key.split(".")
+        try:
+            li = parts.index("layers") + 1
+            layer = int(parts[li])
+            proj = next(p for p in _PEFT_NAMES if p in parts)
+            ab = "a" if "lora_A" in key else "b"
+        except (ValueError, StopIteration):
+            continue
+        name = _PEFT_NAMES[proj]
+        val = np.asarray(val, np.float32)
+        if ab == "a":
+            out[f"{name}_a"][layer] = val.T  # PEFT A: [r, in] -> [in, r]
+        else:
+            out[f"{name}_b"][layer] = val.T * scaling  # PEFT B: [out, r] -> [r, out]
+    return out

@@ -32,6 +32,8 @@ class GenerateSamplingParams(BaseModel):
     json_schema: str | None = None
     regex: str | None = None
     ebnf: str | None = None
+    lora_adapter: str | None = None
+    lora_path: str | None = None  # SGLang-compatible alias
 
 
 class GenerateRequest(BaseModel):
@@ -65,6 +67,7 @@ class GenerateRequest(BaseModel):
             json_schema=g.json_schema,
             regex=g.regex,
             ebnf=g.ebnf,
+            lora_adapter=g.lora_adapter or g.lora_path,
         )
         sp.validate()
         return sp

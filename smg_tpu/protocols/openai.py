@@ -105,6 +105,7 @@ class ChatCompletionRequest(BaseModel):
     ignore_eos: bool = False
     skip_special_tokens: bool = True
     separate_reasoning: bool = True
+    lora_adapter: str | None = None
 
     def to_sampling_params(self, default_max_tokens: int) -> SamplingParams:
         stop = self.stop if isinstance(self.stop, list) else ([self.stop] if self.stop else [])
@@ -130,6 +131,7 @@ class ChatCompletionRequest(BaseModel):
             n=self.n,
             logprobs=self.logprobs,
             top_logprobs=self.top_logprobs or 0,
+            lora_adapter=self.lora_adapter,
         )
         if self.response_format is not None:
             if self.response_format.type == "json_object":
@@ -205,6 +207,7 @@ class CompletionRequest(BaseModel):
     seed: int | None = None
     user: str | None = None
     ignore_eos: bool = False
+    lora_adapter: str | None = None
 
     def to_sampling_params(self, default_max_tokens: int) -> SamplingParams:
         stop = self.stop if isinstance(self.stop, list) else ([self.stop] if self.stop else [])
@@ -222,6 +225,7 @@ class CompletionRequest(BaseModel):
             n=self.n,
             logprobs=self.logprobs is not None,
             top_logprobs=self.logprobs or 0,
+            lora_adapter=self.lora_adapter,
         )
         sp.validate()
         return sp

@@ -36,6 +36,9 @@ class SamplingParams:
     json_schema: str | None = None
     regex: str | None = None
     ebnf: str | None = None
+    # LoRA adapter name (must be loaded on the worker; reference: lora_path
+    # in GenerateRequest + Load/Unload/ListLoRAAdapter RPCs)
+    lora_adapter: str | None = None
 
     def validate(self) -> None:
         if self.max_new_tokens < 0:

@@ -64,6 +64,26 @@ class GrpcWorkerClient(WorkerClient):
             request_serializer=pb.GeneratePrefilledRequestProto.SerializeToString,
             response_deserializer=pb.GenerateChunk.FromString,
         )
+        self._load_lora = c.unary_unary(
+            method("LoadLoRAAdapter"),
+            request_serializer=pb.LoadLoraRequestProto.SerializeToString,
+            response_deserializer=pb.LoraOpResponseProto.FromString,
+        )
+        self._unload_lora = c.unary_unary(
+            method("UnloadLoRAAdapter"),
+            request_serializer=pb.LoadLoraRequestProto.SerializeToString,
+            response_deserializer=pb.LoraOpResponseProto.FromString,
+        )
+        self._list_lora = c.unary_unary(
+            method("ListLoRAAdapters"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.LoraListProto.FromString,
+        )
+        self._get_tokenizer = c.unary_stream(
+            method("GetTokenizer"),
+            request_serializer=pb.EmptyProto.SerializeToString,
+            response_deserializer=pb.TokenizerChunkProto.FromString,
+        )
         self._start_profile = c.unary_unary(
             method("StartProfile"),
             request_serializer=pb.StartProfileRequestProto.SerializeToString,
@@ -236,6 +256,40 @@ class GrpcWorkerClient(WorkerClient):
     async def flush_cache(self) -> bool:
         resp = await self._flush(pb.EmptyProto(), timeout=30)
         return resp.ok
+
+    async def load_lora_adapter(
+        self, name: str, path: str | None = None, data: bytes | None = None
+    ) -> dict:
+        resp = await self._load_lora(
+            pb.LoadLoraRequestProto(name=name, path=path or "", npz=data or b""),
+            timeout=300,
+        )
+        return {"ok": resp.ok, "error": resp.error, "slot": resp.slot}
+
+    async def unload_lora_adapter(self, name: str) -> dict:
+        resp = await self._unload_lora(
+            pb.LoadLoraRequestProto(name=name), timeout=60
+        )
+        return {"ok": resp.ok, "error": resp.error}
+
+    async def list_lora_adapters(self) -> list[str]:
+        resp = await self._list_lora(pb.EmptyProto(), timeout=30)
+        return list(resp.names)
+
+    async def get_tokenizer(self):
+        """Fetch the worker's tokenizer bundle; returns a tokenizer or None."""
+        from smg_tpu.tokenizer.bundle import load_bundle
+
+        parts: list[bytes] = []
+        fmt = sha = ""
+        async for chunk in self._get_tokenizer(pb.EmptyProto(), timeout=300):
+            if chunk.data:
+                parts.append(chunk.data)
+            if chunk.last:
+                fmt, sha = chunk.format, chunk.sha256
+        if fmt in ("", "none"):
+            return None
+        return load_bundle(b"".join(parts), fmt, sha or None)
 
     async def start_profile(
         self, output_dir: str, host_tracer: bool = True,

@@ -38,6 +38,8 @@ def sampling_to_proto(sp: SamplingParams) -> pb.SamplingParamsProto:
         msg.regex = sp.regex
     if sp.ebnf is not None:
         msg.ebnf = sp.ebnf
+    if sp.lora_adapter is not None:
+        msg.lora_adapter = sp.lora_adapter
     return msg
 
 
@@ -61,6 +63,7 @@ def sampling_from_proto(msg: pb.SamplingParamsProto) -> SamplingParams:
         json_schema=msg.json_schema if msg.HasField("json_schema") else None,
         regex=msg.regex if msg.HasField("regex") else None,
         ebnf=msg.ebnf if msg.HasField("ebnf") else None,
+        lora_adapter=msg.lora_adapter if msg.HasField("lora_adapter") else None,
     )
 
 

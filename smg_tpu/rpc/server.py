@@ -194,6 +194,56 @@ class SchedulerServicer:
     async def FlushCache(self, request: pb.EmptyProto, context):
         return pb.FlushResponseProto(ok=self.engine.flush_cache())
 
+    async def LoadLoRAAdapter(self, request: pb.LoadLoraRequestProto, context):
+        loop = asyncio.get_running_loop()
+        try:
+            slot = await loop.run_in_executor(
+                None,
+                lambda: self.engine.load_lora_adapter(
+                    request.name,
+                    path=request.path or None,
+                    data=request.npz or None,
+                ),
+            )
+            return pb.LoraOpResponseProto(ok=True, slot=slot)
+        except Exception as e:
+            return pb.LoraOpResponseProto(ok=False, error=str(e))
+
+    async def UnloadLoRAAdapter(self, request: pb.LoadLoraRequestProto, context):
+        loop = asyncio.get_running_loop()
+        try:
+            ok = await loop.run_in_executor(
+                None, self.engine.unload_lora_adapter, request.name
+            )
+            err = "" if ok else f"adapter {request.name!r} not loaded"
+            return pb.LoraOpResponseProto(ok=ok, error=err)
+        except Exception as e:
+            return pb.LoraOpResponseProto(ok=False, error=str(e))
+
+    async def ListLoRAAdapters(self, request: pb.EmptyProto, context):
+        return pb.LoraListProto(names=self.engine.list_lora_adapters())
+
+    async def GetTokenizer(self, request: pb.EmptyProto, context):
+        from smg_tpu.tokenizer.bundle import make_bundle
+
+        if self.engine.tokenizer is None:
+            yield pb.TokenizerChunkProto(last=True, format="none")
+            return
+        loop = asyncio.get_running_loop()
+        data, fmt, sha = await loop.run_in_executor(
+            None, make_bundle, self.engine.tokenizer
+        )
+        chunk_size = 1 << 20
+        for off in range(0, max(len(data), 1), chunk_size):
+            piece = data[off : off + chunk_size]
+            last = off + chunk_size >= len(data)
+            yield pb.TokenizerChunkProto(
+                data=piece,
+                last=last,
+                sha256=sha if last else "",
+                format=fmt if last else "",
+            )
+
     async def StartProfile(self, request: pb.StartProfileRequestProto, context):
         loop = asyncio.get_running_loop()
         try:
@@ -282,6 +332,26 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             servicer.GetModelInfo,
             request_deserializer=pb.EmptyProto.FromString,
             response_serializer=pb.ModelInfoProto.SerializeToString,
+        ),
+        "LoadLoRAAdapter": grpc.unary_unary_rpc_method_handler(
+            servicer.LoadLoRAAdapter,
+            request_deserializer=pb.LoadLoraRequestProto.FromString,
+            response_serializer=pb.LoraOpResponseProto.SerializeToString,
+        ),
+        "UnloadLoRAAdapter": grpc.unary_unary_rpc_method_handler(
+            servicer.UnloadLoRAAdapter,
+            request_deserializer=pb.LoadLoraRequestProto.FromString,
+            response_serializer=pb.LoraOpResponseProto.SerializeToString,
+        ),
+        "ListLoRAAdapters": grpc.unary_unary_rpc_method_handler(
+            servicer.ListLoRAAdapters,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.LoraListProto.SerializeToString,
+        ),
+        "GetTokenizer": grpc.unary_stream_rpc_method_handler(
+            servicer.GetTokenizer,
+            request_deserializer=pb.EmptyProto.FromString,
+            response_serializer=pb.TokenizerChunkProto.SerializeToString,
         ),
         "StartProfile": grpc.unary_unary_rpc_method_handler(
             servicer.StartProfile,

@@ -27,6 +27,11 @@ class TokenizerRegistry:
             if default or self._default is None:
                 self._default = tokenizer
 
+    def has(self, model_id: str) -> bool:
+        """Exact registration check (``get`` falls back to the default)."""
+        with self._lock:
+            return model_id in self._tokenizers
+
     def get(self, model_id: str | None = None):
         with self._lock:
             if model_id and model_id in self._tokenizers:

@@ -1,0 +1,211 @@
+"""Multi-LoRA serving: adapter bank, per-request adapter selection, PEFT
+loading, and the gateway admin surface (reference:
+Load/Unload/ListLoRAAdapter RPCs, sglang_scheduler.proto:48-62)."""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.models.lora import empty_adapter, load_peft_dir, validate_adapter
+from smg_tpu.protocols.sampling import SamplingParams
+
+
+def make_engine(**kw) -> Engine:
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4,),
+        ),
+        dtype="float32",
+        **kw,
+    )
+    return Engine(cfg)
+
+
+def strong_adapter(cfg, rank=4, seed=0):
+    rng = np.random.default_rng(seed)
+    w = empty_adapter(cfg, rank)
+    for p in ("wq", "wk", "wv", "wo"):
+        w[f"{p}_a"] = rng.normal(0, 0.5, w[f"{p}_a"].shape).astype(np.float32)
+        w[f"{p}_b"] = rng.normal(0, 0.5, w[f"{p}_b"].shape).astype(np.float32)
+    return w
+
+
+def greedy(max_new=6, **kw) -> SamplingParams:
+    return SamplingParams(temperature=0.0, max_new_tokens=max_new, ignore_eos=True, **kw)
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return make_engine()
+
+
+def test_zero_adapter_is_identity(eng):
+    prompt = list(range(5, 25))
+    base = eng.generate(prompt_ids=prompt, sampling=greedy())
+    eng.flush_cache()
+    eng.runner.load_lora("zero", empty_adapter(eng.config.model, rank=4))
+    z = eng.generate(prompt_ids=prompt, sampling=greedy(lora_adapter="zero"))
+    eng.flush_cache()
+    assert z.token_ids == base.token_ids
+
+
+def test_adapter_switching_changes_outputs(eng):
+    prompt = list(range(5, 25))
+    base = eng.generate(prompt_ids=prompt, sampling=greedy())
+    eng.flush_cache()
+    eng.runner.load_lora("strong", strong_adapter(eng.config.model))
+    s = eng.generate(prompt_ids=prompt, sampling=greedy(lora_adapter="strong"))
+    eng.flush_cache()
+    assert s.token_ids != base.token_ids
+    # switching back to base restores the original stream exactly
+    again = eng.generate(prompt_ids=prompt, sampling=greedy())
+    eng.flush_cache()
+    assert again.token_ids == base.token_ids
+
+
+def test_mixed_batch_base_stream_exact(eng):
+    """Adapted and base requests share one decode batch; the base request's
+    stream must match its solo run token for token."""
+    prompt_a = list(range(60, 80))
+    prompt_b = list(range(80, 100))
+    solo = eng.generate(prompt_ids=prompt_a, sampling=greedy(8))
+    eng.flush_cache()
+    eng.runner.load_lora("strong2", strong_adapter(eng.config.model, seed=7))
+
+    chunks: dict[str, list[int]] = {"plain": [], "adapted": []}
+    done = set()
+
+    def mk(rid):
+        def cb(o):
+            chunks[rid].extend(o.new_token_ids)
+            if o.finished:
+                done.add(rid)
+        return cb
+
+    eng.submit(prompt_a, greedy(8), rid="plain", on_output=mk("plain"))
+    eng.submit(prompt_b, greedy(8, lora_adapter="strong2"), rid="adapted",
+               on_output=mk("adapted"))
+    import time
+    deadline = time.monotonic() + 120
+    while len(done) < 2 and time.monotonic() < deadline:
+        eng.step()
+    assert done == {"plain", "adapted"}
+    assert chunks["plain"] == solo.token_ids
+
+
+def test_unknown_adapter_rejected(eng):
+    with pytest.raises(ValueError, match="unknown LoRA adapter"):
+        eng.submit(list(range(5, 15)), greedy(lora_adapter="nope"))
+
+
+def test_bank_slot_reuse_and_capacity(eng):
+    names_before = set(eng.list_lora_adapters())
+    # replacing an existing name reuses its slot
+    idx1 = eng.runner.load_lora("zero", empty_adapter(eng.config.model, rank=4))
+    idx2 = eng.runner.load_lora("zero", empty_adapter(eng.config.model, rank=4))
+    assert idx1 == idx2
+    assert set(eng.list_lora_adapters()) == names_before | {"zero"}
+
+
+def test_peft_dir_loading(tmp_path):
+    """HF PEFT layout (adapter_config.json + per-layer lora_A/B tensors)
+    converts to the canonical stacked bank layout with alpha/r folded in."""
+    cfg = tiny_test_config()
+    r, alpha = 2, 8
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    rng = np.random.default_rng(3)
+    tensors = {}
+    for layer in range(cfg.num_layers):
+        a = rng.normal(0, 1, (r, E)).astype(np.float32)
+        b = rng.normal(0, 1, (H * D, r)).astype(np.float32)
+        prefix = f"base_model.model.model.layers.{layer}.self_attn.q_proj"
+        tensors[f"{prefix}.lora_A.weight"] = a
+        tensors[f"{prefix}.lora_B.weight"] = b
+    d = tmp_path / "adapter"
+    d.mkdir()
+    (d / "adapter_config.json").write_text(
+        json.dumps({"r": r, "lora_alpha": alpha, "target_modules": ["q_proj"]})
+    )
+    np.savez(d / "adapter_model.npz", **tensors)
+
+    w = load_peft_dir(str(d), cfg)
+    assert validate_adapter(cfg, w) == r
+    # A transposed, B transposed and scaled by alpha/r
+    a0 = tensors["base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"]
+    b0 = tensors["base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"]
+    np.testing.assert_allclose(w["wq_a"][0], a0.T)
+    np.testing.assert_allclose(w["wq_b"][0], b0.T * (alpha / r))
+    # untargeted projections stay zero (no-op)
+    assert not w["wk_a"].any() and not w["wo_b"].any()
+
+
+def test_gateway_lora_admin_and_request(tmp_path):
+    """Load an adapter through the gateway admin endpoint, generate with and
+    without it via /v1/chat/completions, list and unload it."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.worker_client import InProcWorkerClient
+    from smg_tpu.gateway.workers import Worker
+    from smg_tpu.tokenizer import MockTokenizer
+
+    engine = make_engine(model_id="tiny-test")
+    adapter = strong_adapter(engine.config.model, seed=11)
+    npz_path = tmp_path / "strong.npz"
+    np.savez(npz_path, **adapter)
+
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+
+    async def go():
+        ctx.registry.add(Worker(
+            worker_id="w0", client=InProcWorkerClient(engine), model_id="tiny-test",
+        ))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        body = {"model": "tiny-test",
+                "messages": [{"role": "user", "content": "w5 w6 w7"}],
+                "max_tokens": 6, "temperature": 0, "ignore_eos": True}
+        base = await (await tc.post("/v1/chat/completions", json=body)).json()
+
+        r = await tc.post("/load_lora_adapter",
+                          json={"lora_name": "strong", "lora_path": str(npz_path)})
+        load_body = await r.json()
+
+        adapted = await (await tc.post(
+            "/v1/chat/completions", json={**body, "lora_adapter": "strong"}
+        )).json()
+        listed = await (await tc.get("/list_lora_adapters")).json()
+        unload = await (await tc.post("/unload_lora_adapter",
+                                      json={"lora_name": "strong"})).json()
+        missing = await (await tc.post(
+            "/v1/chat/completions", json={**body, "lora_adapter": "strong"}
+        )).json()
+        await tc.close()
+        return base, load_body, adapted, listed, unload, missing
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        base, load_body, adapted, listed, unload, missing = (
+            asyncio.run_coroutine_threadsafe(go(), loop).result(timeout=180)
+        )
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+    assert load_body["ok"], load_body
+    assert listed["workers"]["w0"] == ["strong"]
+    base_text = base["choices"][0]["message"]["content"]
+    adapted_text = adapted["choices"][0]["message"]["content"]
+    assert adapted_text != base_text, "adapter did not change the output"
+    assert unload["ok"], unload
+    assert "error" in missing, missing  # unloaded adapter now rejects

@@ -27,7 +27,9 @@ def make_engine() -> Engine:
         dtype="float32",
         model_id="tiny-rpc",
     )
-    return Engine(cfg)
+    from smg_tpu.tokenizer import MockTokenizer
+
+    return Engine(cfg, tokenizer=MockTokenizer())
 
 
 @pytest.fixture(scope="module")
@@ -55,6 +57,7 @@ def rpc():
     h = H()
     h.run = run
     h.client = client
+    h.engine = engine
     yield h
     run(client.close())
     run(server.stop(grace=None))
@@ -143,3 +146,60 @@ def test_abort_over_grpc(rpc):
 
 def test_flush_cache_over_grpc(rpc):
     assert rpc.run(rpc.client.flush_cache()) is True
+
+
+def test_lora_rpcs_over_grpc(rpc, tmp_path):
+    """Load/Unload/ListLoRAAdapter over the wire with an inline npz payload;
+    a generate carrying lora_adapter uses it."""
+    import io
+
+    import numpy as np
+
+    from smg_tpu.models.lora import empty_adapter
+
+    cfg = rpc.engine.config.model
+    rng = np.random.default_rng(5)
+    w = empty_adapter(cfg, rank=4)
+    for pr in ("wq", "wk", "wv", "wo"):
+        w[f"{pr}_a"] = rng.normal(0, 0.5, w[f"{pr}_a"].shape).astype(np.float32)
+        w[f"{pr}_b"] = rng.normal(0, 0.5, w[f"{pr}_b"].shape).astype(np.float32)
+    buf = io.BytesIO()
+    np.savez(buf, **w)
+
+    async def go():
+        base_chunks = []
+        req = WorkerGenerateRequest(
+            rid="lora-base", input_ids=list(range(5, 25)),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=5, ignore_eos=True),
+        )
+        async for c in rpc.client.generate(req):
+            base_chunks.extend(c.token_ids)
+
+        r = await rpc.client.load_lora_adapter("wire-adapter", data=buf.getvalue())
+        names = await rpc.client.list_lora_adapters()
+
+        adapted_chunks = []
+        req2 = WorkerGenerateRequest(
+            rid="lora-on", input_ids=list(range(5, 25)),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=5,
+                                    ignore_eos=True, lora_adapter="wire-adapter"),
+        )
+        async for c in rpc.client.generate(req2):
+            adapted_chunks.extend(c.token_ids)
+        un = await rpc.client.unload_lora_adapter("wire-adapter")
+        return base_chunks, r, names, adapted_chunks, un
+
+    base_chunks, r, names, adapted_chunks, un = rpc.run(go())
+    assert r["ok"], r
+    assert "wire-adapter" in names
+    assert adapted_chunks != base_chunks
+    assert un["ok"]
+
+
+def test_get_tokenizer_bundle_over_grpc(rpc):
+    """GetTokenizer streams a bundle the gateway can materialize into a
+    working tokenizer (mock descriptor for the test engine)."""
+    tok = rpc.run(rpc.client.get_tokenizer())
+    assert tok is not None
+    assert tok.encode("w5 w6") == [5, 6]
+    assert tok.decode([7, 8]) == "w7 w8"
