@@ -36,6 +36,12 @@ def build_spec() -> dict:
         ErrorResponse,
         ModelList,
     )
+    from smg_tpu.protocols.rerank import (
+        ClassifyRequest,
+        ClassifyResponse,
+        RerankRequest,
+        RerankResponse,
+    )
     from smg_tpu.protocols.responses import ResponsesRequest, ResponsesResponse
     from smg_tpu.version import __version__
 
@@ -46,6 +52,7 @@ def build_spec() -> dict:
         AnthropicMessagesRequest, AnthropicMessagesResponse,
         ResponsesRequest, ResponsesResponse,
         GenerateRequest, GenerateResponse,
+        RerankRequest, RerankResponse, ClassifyRequest, ClassifyResponse,
         ModelList, ErrorResponse,
     ]
     _, defs = models_json_schema(
@@ -86,6 +93,10 @@ def build_spec() -> dict:
             "CompletionResponse", streaming=True)},
         "/v1/embeddings": {"post": op(
             "openai", "Embeddings", "EmbeddingRequest", "EmbeddingResponse")},
+        "/v1/rerank": {"post": op(
+            "native", "Rerank documents", "RerankRequest", "RerankResponse")},
+        "/v1/classify": {"post": op(
+            "native", "Classify inputs", "ClassifyRequest", "ClassifyResponse")},
         "/v1/messages": {"post": op(
             "anthropic", "Anthropic Messages", "AnthropicMessagesRequest",
             "AnthropicMessagesResponse", streaming=True)},

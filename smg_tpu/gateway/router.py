@@ -570,19 +570,103 @@ class Router:
         else:
             raise RouteError(400, "invalid embeddings input")
 
+        vecs, total_tokens = await self._embed_batches(model_id, batches, request_id)
+        data = [EmbeddingData(index=i, embedding=v) for i, v in enumerate(vecs)]
+        usage = UsageInfo(prompt_tokens=total_tokens, total_tokens=total_tokens)
+        return EmbeddingResponse(data=data, model=req.model or "default", usage=usage)
+
+    async def _embed_batches(self, model_id, batches: list, request_id):
+        """Single guarded worker embed leg (shared by embeddings, rerank,
+        classify).  Returns (vectors, total_tokens)."""
         ctx = RequestContext(model_id=model_id, request_id=request_id)
         worker = self.select_worker(ctx)
         guard = worker.acquire()
         try:
             vecs = await worker.client.embed(batches)
-            data = [EmbeddingData(index=i, embedding=v) for i, v in enumerate(vecs)]
-            total_tokens = sum(len(b) for b in batches)
             guard.release(success=True)
         except Exception as e:
             guard.release(success=False)
             raise RouteError(502, f"worker embed error: {e}", "worker_error")
-        usage = UsageInfo(prompt_tokens=total_tokens, total_tokens=total_tokens)
-        return EmbeddingResponse(data=data, model=req.model or "default", usage=usage)
+        return vecs, sum(len(b) for b in batches)
+
+    async def _embed_texts(self, model_id: str | None, texts: list[str], request_id):
+        batches = [self.tokenizers.encode_cached(model_id, t) for t in texts]
+        return await self._embed_batches(model_id, batches, request_id)
+
+    @staticmethod
+    def _unit_rows(vecs) -> "object":
+        """Normalize embedding rows once; cosine becomes a plain dot."""
+        import numpy as np
+
+        arr = np.asarray(vecs, np.float64)
+        norms = np.linalg.norm(arr, axis=-1, keepdims=True)
+        return arr / np.where(norms == 0, 1.0, norms)
+
+    async def rerank(self, req, request_id: str | None = None):
+        """Query-document relevance scoring via the embedding path
+        (reference: /v1/rerank, server.rs:188-221)."""
+        from smg_tpu.protocols.rerank import RerankResponse, RerankResult
+
+        if not req.documents:
+            raise RouteError(400, "documents must be non-empty")
+        vecs, total = await self._embed_texts(
+            req.model or None, [req.query] + req.documents, request_id
+        )
+        unit = self._unit_rows(vecs)
+        scores = unit[1:] @ unit[0]
+        results = [
+            RerankResult(
+                index=i,
+                relevance_score=float(s),
+                document=req.documents[i] if req.return_documents else None,
+            )
+            for i, s in enumerate(scores)
+        ]
+        results.sort(key=lambda r: r.relevance_score, reverse=True)
+        if req.top_n is not None:
+            results = results[: max(req.top_n, 0)]
+        return RerankResponse(
+            model=req.model or "default",
+            results=results,
+            usage=UsageInfo(prompt_tokens=total, total_tokens=total),
+        )
+
+    async def classify(self, req, request_id: str | None = None):
+        """Zero-shot classification over caller labels: softmax of
+        input-label embedding similarities (reference: /v1/classify,
+        server.rs:287-300)."""
+        import numpy as np
+
+        from smg_tpu.protocols.rerank import ClassifyData, ClassifyResponse
+
+        if not req.labels:
+            raise RouteError(400, "labels must be non-empty")
+        if len(set(req.labels)) != len(req.labels):
+            raise RouteError(400, "labels must be unique")
+        inputs = [req.input] if isinstance(req.input, str) else list(req.input)
+        if not inputs:
+            raise RouteError(400, "input must be non-empty")
+        vecs, total = await self._embed_texts(
+            req.model or None, inputs + req.labels, request_id
+        )
+        unit = self._unit_rows(vecs)
+        in_vecs, label_vecs = unit[: len(inputs)], unit[len(inputs) :]
+        sims = in_vecs @ label_vecs.T  # [I, L]
+        exps = np.exp(sims - sims.max(axis=-1, keepdims=True))
+        probs = exps / exps.sum(axis=-1, keepdims=True)
+        data = []
+        for i, row in enumerate(probs):
+            best = int(np.argmax(row))
+            data.append(ClassifyData(
+                index=i,
+                label=req.labels[best],
+                scores={lab: float(p) for lab, p in zip(req.labels, row)},
+            ))
+        return ClassifyResponse(
+            model=req.model or "default",
+            data=data,
+            usage=UsageInfo(prompt_tokens=total, total_tokens=total),
+        )
 
     # ---- Anthropic Messages ----
 

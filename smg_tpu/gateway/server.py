@@ -91,6 +91,7 @@ INFERENCE_ROUTES = frozenset(
     {
         "/v1/chat/completions", "/v1/completions", "/generate",
         "/v1/messages", "/v1/embeddings",
+        "/v1/rerank", "/rerank", "/v1/classify",
     }
 )
 
@@ -226,6 +227,9 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_post("/v1/completions", h_completions)
     app.router.add_post("/generate", h_generate)
     app.router.add_post("/v1/embeddings", h_embeddings)
+    app.router.add_post("/v1/rerank", h_rerank)
+    app.router.add_post("/rerank", h_rerank)  # reference alias (server.rs route table)
+    app.router.add_post("/v1/classify", h_classify)
     app.router.add_post("/v1/messages", h_anthropic_messages)
     app.router.add_post("/parse/function_call", h_parse_function_call)
     app.router.add_post("/parse/reasoning", h_parse_reasoning)
@@ -498,6 +502,38 @@ async def h_embeddings(request: web.Request) -> web.Response:
         return _error(400, f"invalid request: {e}")
     async with ctx.semaphore:
         resp = await ctx.router.embeddings(req, request_id=request["request_id"])
+        return web.json_response(resp.model_dump())
+
+
+async def h_rerank(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.rerank import RerankRequest
+
+    try:
+        req = RerankRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    async with ctx.semaphore:
+        try:
+            resp = await ctx.router.rerank(req, request_id=request["request_id"])
+        except RouteError as e:
+            return _error(e.status, e.message, e.err_type)
+        return web.json_response(resp.model_dump(exclude_none=True))
+
+
+async def h_classify(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.rerank import ClassifyRequest
+
+    try:
+        req = ClassifyRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    async with ctx.semaphore:
+        try:
+            resp = await ctx.router.classify(req, request_id=request["request_id"])
+        except RouteError as e:
+            return _error(e.status, e.message, e.err_type)
         return web.json_response(resp.model_dump())
 
 

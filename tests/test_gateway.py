@@ -473,3 +473,67 @@ def test_profile_start_stop_roundtrip(gateway, tmp_path):
     for root, _dirs, files in os.walk(trace_dir):
         found += [f for f in files if f.endswith((".xplane.pb", ".json.gz", ".trace"))]
     assert found, f"no trace artifacts under {trace_dir}"
+
+
+def test_rerank_endpoint(gateway):
+    """/v1/rerank returns per-document relevance ordered best-first; the
+    query itself embedded as a document must rank #1 (reference behavior:
+    server.rs:188-221)."""
+    async def go():
+        body = {
+            "model": "tiny-test",
+            "query": "w10 w11 w12",
+            "documents": ["w90 w91", "w10 w11 w12", "w40 w41 w42 w43"],
+        }
+        r = await gateway.client.post("/v1/rerank", json=body)
+        return r.status, await r.json()
+
+    status, body = gateway.run(go())
+    assert status == 200, body
+    results = body["results"]
+    assert len(results) == 3
+    scores = [r["relevance_score"] for r in results]
+    assert scores == sorted(scores, reverse=True)
+    assert results[0]["index"] == 1  # identical text wins
+    assert results[0]["relevance_score"] == pytest.approx(1.0, abs=1e-4)
+    assert results[0]["document"] == "w10 w11 w12"
+    assert body["usage"]["prompt_tokens"] > 0
+
+
+def test_rerank_top_n_and_no_documents(gateway):
+    async def go():
+        r1 = await gateway.client.post("/v1/rerank", json={
+            "model": "tiny-test", "query": "w1 w2",
+            "documents": ["w3", "w4", "w5"], "top_n": 2,
+            "return_documents": False,
+        })
+        r2 = await gateway.client.post("/v1/rerank", json={
+            "model": "tiny-test", "query": "w1", "documents": []})
+        return (r1.status, await r1.json()), r2.status
+
+    (s1, b1), s2 = gateway.run(go())
+    assert s1 == 200 and len(b1["results"]) == 2
+    assert "document" not in b1["results"][0]
+    assert s2 == 400
+
+
+def test_classify_endpoint(gateway):
+    """/v1/classify: zero-shot over caller labels; an input identical to a
+    label must classify as that label (reference: server.rs:287-300)."""
+    async def go():
+        r = await gateway.client.post("/v1/classify", json={
+            "model": "tiny-test",
+            "input": ["w7 w8 w9", "w77 w78"],
+            "labels": ["w7 w8 w9", "w77 w78"],
+        })
+        return r.status, await r.json()
+
+    status, body = gateway.run(go())
+    assert status == 200, body
+    assert len(body["data"]) == 2
+    assert body["data"][0]["label"] == "w7 w8 w9"
+    assert body["data"][1]["label"] == "w77 w78"
+    for d in body["data"]:
+        probs = list(d["scores"].values())
+        assert abs(sum(probs) - 1.0) < 1e-6
+        assert len(probs) == 2
