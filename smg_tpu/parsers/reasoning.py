@@ -147,6 +147,10 @@ def get_reasoning_parser(name_or_model: str | None) -> ReasoningParser:
     if not name_or_model or name_or_model == "passthrough":
         return PassthroughReasoningParser()
     key = name_or_model.lower()
+    if key == "harmony" or "gpt-oss" in key:
+        from smg_tpu.parsers.harmony import HarmonyReasoningParser
+
+        return HarmonyReasoningParser()
     if key in _FAMILIES:
         o, c, init = _FAMILIES[key]
         p = ReasoningParser(o, c, init)

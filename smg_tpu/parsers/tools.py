@@ -521,6 +521,187 @@ class Step3ToolParser(TagBlockToolParser):
     close_tag = "</step_tool_call>"
 
 
+class DeepSeek31ToolParser(ToolCallParser):
+    """DeepSeek-V3.1 dialect (reference: parsers/deepseek31.rs): like the V3
+    block format but with no ``function`` type prefix and raw JSON args —
+    ``<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>NAME<｜tool▁sep｜>{json}
+    <｜tool▁call▁end｜>…<｜tool▁calls▁end｜>``.  Non-object JSON args wrap as
+    ``{"value": …}``."""
+
+    name = "deepseek31"
+    _EOS = "<｜end▁of▁sentence｜>"
+    start_markers = ("<｜tool▁calls▁begin｜>", _EOS)
+    _call_re = re.compile(
+        r"<｜tool▁call▁begin｜>(.*?)<｜tool▁sep｜>(.*?)<｜tool▁call▁end｜>", re.S
+    )
+
+    def _try_extract(self, buf):
+        if buf.startswith(self._EOS):  # stray EOS sentinel: consume silently
+            return [], buf[len(self._EOS):], True
+        end = buf.find("<｜tool▁calls▁end｜>")
+        if end == -1:
+            return [], buf, False
+        block = buf[:end]
+        rest = buf[end + len("<｜tool▁calls▁end｜>"):].replace(self._EOS, "")
+        calls = []
+        for m in self._call_re.finditer(block):
+            raw = m.group(2).replace(self._EOS, "").strip()
+            try:
+                val = json.loads(raw)
+            except ValueError:
+                val = parse_partial(raw)
+            if not isinstance(val, dict):
+                val = {"value": val}
+            calls.append(
+                ParsedToolCall(name=m.group(1).strip(), arguments=_json_args(val))
+            )
+        return calls, rest, True
+
+
+class DeepseekDsmlToolParser(ToolCallParser):
+    """DeepSeek DSML dialect (reference: parsers/deepseek_dsml.rs):
+    ``<｜DSML｜invoke name="func"> <｜DSML｜parameter name="k" string="true">v
+    </｜DSML｜parameter> … </｜DSML｜invoke>`` — parameters typed by the
+    ``string`` attribute (false => parse value as JSON), or a direct JSON
+    object body."""
+
+    name = "deepseek_dsml"
+    start_markers = ("<｜DSML｜invoke",)
+    _invoke_re = re.compile(
+        r'<｜DSML｜invoke\s+name="([^"]+)"\s*>(.*?)</｜DSML｜invoke>', re.S
+    )
+    _param_re = re.compile(
+        r'<｜DSML｜parameter\s+name="([^"]+)"(?:\s+string="(true|false)")?\s*>'
+        r"(.*?)</｜DSML｜parameter>",
+        re.S,
+    )
+    _EOS = "<｜end▁of▁sentence｜>"
+
+    def _try_extract(self, buf):
+        m = self._invoke_re.match(buf)
+        if m is None:
+            if "</｜DSML｜invoke>" in buf:
+                # closed but unparseable invoke: drop the frame as protocol data
+                end = buf.find("</｜DSML｜invoke>") + len("</｜DSML｜invoke>")
+                return [], buf[end:], True
+            return [], buf, False
+        body = m.group(2).replace(self._EOS, "")
+        rest = buf[m.end():]
+        stripped = body.strip()
+        if stripped.startswith("{") and stripped.endswith("}"):
+            try:
+                args = json.loads(stripped)
+            except ValueError:
+                args = parse_partial(stripped) or {}
+        else:
+            args = {}
+            for pm in self._param_re.finditer(body):
+                key, is_string, value = pm.group(1), pm.group(2), pm.group(3)
+                if (is_string or "true") == "true":
+                    args[key] = value
+                else:
+                    try:
+                        args[key] = json.loads(value.strip())
+                    except ValueError:
+                        args[key] = value
+        return [ParsedToolCall(name=m.group(1), arguments=_json_args(args))], rest, True
+
+
+def _xml_unescape(s: str) -> str:
+    import html
+
+    return html.unescape(s)
+
+
+class QwenXmlToolParser(ToolCallParser):
+    """Qwen3-Coder XML dialect (reference: parsers/qwen_xml.rs):
+    ``<tool_call>\\n<function=NAME>\\n<parameter=KEY>\\nVALUE\\n</parameter>
+    …\\n</function>\\n</tool_call>`` with XML-entity unescaping and
+    best-effort value typing (JSON literals parse, everything else strings)."""
+
+    name = "qwen_xml"
+    start_markers = ("<tool_call>",)
+    _fn_re = re.compile(r"<function=([^>]+)>")
+    _param_re = re.compile(r"<parameter=([^>]+)>(.*?)</parameter>", re.S)
+    _JSONISH = re.compile(r"^(?:-?\d|\{|\[|true\b|false\b|null\b)")
+
+    def _coerce(self, value: str):
+        v = _xml_unescape(value.strip("\n"))
+        s = v.strip()
+        if self._JSONISH.match(s):
+            try:
+                return json.loads(s)
+            except ValueError:
+                pass
+        return v
+
+    def _try_extract(self, buf):
+        end = buf.find("</tool_call>")
+        if end == -1:
+            return [], buf, False
+        body = buf[len("<tool_call>"): end]
+        rest = buf[end + len("</tool_call>"):]
+        fm = self._fn_re.search(body)
+        if fm is None or not fm.group(1).strip():
+            return [], rest, True  # malformed frame: drop as protocol data
+        args = {
+            pm.group(1).strip(): self._coerce(pm.group(2))
+            for pm in self._param_re.finditer(body)
+        }
+        return (
+            [ParsedToolCall(name=fm.group(1).strip(), arguments=_json_args(args))],
+            rest,
+            True,
+        )
+
+
+class InklingToolParser(ToolCallParser):
+    """Inkling typed-message dialect (reference: parsers/inkling.rs):
+    ``<|content_invoke_tool_json|>{json}<|end_message|>`` frames carry calls;
+    text-mode invocations are protocol data and are discarded; other control
+    tokens are stripped from normal text."""
+
+    name = "inkling"
+    _JSON_START = "<|content_invoke_tool_json|>"
+    _TEXT_START = "<|content_invoke_tool_text|>"
+    _END = "<|end_message|>"
+    _END_SAMPLING = "<|content_model_end_sampling|>"
+    # control tokens consumed silently from the normal-text stream
+    _CONTROL = ("<|message_model|>", "<|content_text|>", "<|content_thinking|>",
+                _END, _END_SAMPLING)
+    start_markers = (_JSON_START, _TEXT_START) + _CONTROL
+
+    def _try_extract(self, buf):
+        for tok in self._CONTROL:
+            if buf.startswith(tok):
+                return [], buf[len(tok):], True
+        if buf.startswith(self._TEXT_START):
+            # text-mode tool frames can't map to OpenAI calls: drop the frame
+            end = buf.find(self._END)
+            if end == -1:
+                return [], buf, False
+            return [], buf[end + len(self._END):], True
+        payload = buf[len(self._JSON_START):].lstrip()
+        try:
+            obj, jend = json.JSONDecoder().raw_decode(payload)
+        except json.JSONDecodeError:
+            if self._END in payload:  # malformed but closed frame: suppress it
+                end = buf.find(self._END)
+                return [], buf[end + len(self._END):], True
+            return [], buf, False
+        rest = payload[jend:]
+        stripped = rest.lstrip()
+        for tok in (self._END, self._END_SAMPLING):
+            if stripped.startswith(tok):
+                rest = stripped[len(tok):]
+                break
+        calls = []
+        if isinstance(obj, dict) and obj.get("name"):
+            args = obj.get("arguments", obj.get("parameters", {}))
+            calls.append(ParsedToolCall(name=obj["name"], arguments=_json_args(args)))
+        return calls, rest, True
+
+
 _PARSERS: dict[str, type[ToolCallParser]] = {
     p.name: p
     for p in (
@@ -529,6 +710,8 @@ _PARSERS: dict[str, type[ToolCallParser]] = {
         MistralToolParser,
         Llama3ToolParser,
         DeepseekV3ToolParser,
+        DeepSeek31ToolParser,
+        DeepseekDsmlToolParser,
         KimiK2ToolParser,
         Glm4MoeToolParser,
         PythonicToolParser,
@@ -536,19 +719,26 @@ _PARSERS: dict[str, type[ToolCallParser]] = {
         CohereToolParser,
         SarashinaToolParser,
         Step3ToolParser,
+        QwenXmlToolParser,
+        InklingToolParser,
         PassthroughToolParser,
     )
 }
 
 _MODEL_MAP = [
-    ("qwen3-coder", "qwen"),
+    ("qwen3-coder", "qwen_xml"),
     ("qwen", "qwen"),
     ("mistral", "mistral"),
     ("mixtral", "mistral"),
     ("llama-4", "pythonic"),
     ("llama4", "pythonic"),
     ("llama", "llama"),
+    ("deepseek-v3.1", "deepseek31"),
+    ("deepseek-3.1", "deepseek31"),
+    ("dsml", "deepseek_dsml"),
     ("deepseek", "deepseek"),
+    ("inkling", "inkling"),
+    ("gpt-oss", "harmony"),
     ("kimi-k2", "kimik2"),
     ("kimi", "kimik2"),
     ("glm-4", "glm4_moe"),
@@ -562,13 +752,21 @@ _MODEL_MAP = [
 ]
 
 
+def _make(parser_name: str) -> ToolCallParser:
+    if parser_name == "harmony":  # lazy: harmony.py imports from this module
+        from smg_tpu.parsers.harmony import HarmonyToolParser
+
+        return HarmonyToolParser()
+    return _PARSERS[parser_name]()
+
+
 def get_tool_parser(name_or_model: str | None) -> ToolCallParser:
     if not name_or_model:
         return JsonToolParser()
     key = name_or_model.lower()
-    if key in _PARSERS:
-        return _PARSERS[key]()
+    if key in _PARSERS or key == "harmony":
+        return _make(key)
     for sub, parser_name in _MODEL_MAP:
         if sub in key:
-            return _PARSERS[parser_name]()
+            return _make(parser_name)
     return JsonToolParser()

@@ -226,3 +226,210 @@ def test_sarashina_parser():
     # plain list text is not a call
     normal, calls = p.parse_full("[1, 2, 3] is a list")
     assert calls == []
+
+
+# ---- new dialects: deepseek31, dsml, qwen_xml, inkling, harmony ----
+
+
+def stream_tool_chunks(parser, text, n=3):
+    normal = ""
+    calls = []
+    for i in range(0, len(text), n):
+        d = parser.feed(text[i : i + n])
+        normal += d.normal_text
+        calls += d.calls
+    d = parser.flush()
+    return normal + d.normal_text, calls + d.calls
+
+
+DS31 = ("I'll check the weather."
+        "<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>get_weather<｜tool▁sep｜>"
+        '{"city": "Paris"}<｜tool▁call▁end｜>'
+        "<｜tool▁call▁begin｜>search<｜tool▁sep｜>"
+        '{"q": "tpu"}<｜tool▁call▁end｜><｜tool▁calls▁end｜>'
+        "<｜end▁of▁sentence｜>")
+
+
+def test_deepseek31_parser():
+    p = get_tool_parser("deepseek-v3.1")
+    normal, calls = p.parse_full(DS31)
+    assert normal == "I'll check the weather."
+    assert [c.name for c in calls] == ["get_weather", "search"]
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+    assert json.loads(calls[1].arguments) == {"q": "tpu"}
+
+
+def test_deepseek31_streaming_chunked():
+    for n in (1, 3, 7, 11):
+        p = get_tool_parser("deepseek31")
+        normal, calls = stream_tool_chunks(p, DS31, n=n)
+        assert normal == "I'll check the weather.", (n, normal)
+        assert [c.name for c in calls] == ["get_weather", "search"], n
+
+
+def test_deepseek31_non_object_args_wrap():
+    p = get_tool_parser("deepseek31")
+    text = ("<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>f<｜tool▁sep｜>"
+            "[1, 2]<｜tool▁call▁end｜><｜tool▁calls▁end｜>")
+    _, calls = p.parse_full(text)
+    assert json.loads(calls[0].arguments) == {"value": [1, 2]}
+
+
+DSML = ('Let me call a tool. <｜DSML｜invoke name="get_weather">'
+        '<｜DSML｜parameter name="city" string="true">Paris</｜DSML｜parameter>'
+        '<｜DSML｜parameter name="days" string="false">3</｜DSML｜parameter>'
+        "</｜DSML｜invoke> done")
+
+
+def test_deepseek_dsml_parser():
+    p = get_tool_parser("deepseek-dsml")
+    normal, calls = p.parse_full(DSML)
+    assert normal == "Let me call a tool.  done"
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris", "days": 3}
+
+
+def test_deepseek_dsml_json_body_and_streaming():
+    text = ('<｜DSML｜invoke name="f">{"x": 1}</｜DSML｜invoke>')
+    for n in (1, 4, 9):
+        p = get_tool_parser("deepseek_dsml")
+        normal, calls = stream_tool_chunks(p, text, n=n)
+        assert normal == "", n
+        assert json.loads(calls[0].arguments) == {"x": 1}, n
+
+
+QWEN_XML = ("Sure.<tool_call>\n<function=get_weather>\n"
+            "<parameter=city>\nSan Francisco\n</parameter>\n"
+            "<parameter=days>\n3\n</parameter>\n"
+            "<parameter=note>\nTom &amp; Jerry &lt;3\n</parameter>\n"
+            "</function>\n</tool_call>")
+
+
+def test_qwen_xml_parser():
+    p = get_tool_parser("qwen3-coder-480b")
+    assert p.name == "qwen_xml"
+    normal, calls = p.parse_full(QWEN_XML)
+    assert normal == "Sure."
+    assert calls[0].name == "get_weather"
+    args = json.loads(calls[0].arguments)
+    assert args["city"] == "San Francisco"
+    assert args["days"] == 3  # JSON literal coerced
+    assert args["note"] == "Tom & Jerry <3"  # entities unescaped
+
+
+def test_qwen_xml_streaming_chunked():
+    for n in (1, 5, 13):
+        p = get_tool_parser("qwen_xml")
+        normal, calls = stream_tool_chunks(p, QWEN_XML, n=n)
+        assert normal == "Sure.", n
+        assert len(calls) == 1 and calls[0].name == "get_weather", n
+
+
+INKLING = ("<|content_text|>Checking."
+           '<|content_invoke_tool_json|>{"name": "get_weather", '
+           '"arguments": {"city": "Paris"}}<|end_message|>'
+           "<|content_text|>Done.<|content_model_end_sampling|>")
+
+
+def test_inkling_parser():
+    p = get_tool_parser("inkling-1")
+    normal, calls = p.parse_full(INKLING)
+    assert normal == "Checking.Done."
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+def test_inkling_text_mode_discarded_and_streaming():
+    text = ("A<|content_invoke_tool_text|>call tool here<|end_message|>B"
+            '<|content_invoke_tool_json|>{"name": "f", "arguments": {}}'
+            "<|end_message|>C")
+    for n in (1, 4, 10):
+        p = get_tool_parser("inkling")
+        normal, calls = stream_tool_chunks(p, text, n=n)
+        assert normal == "ABC", (n, normal)
+        assert [c.name for c in calls] == ["f"], n
+
+
+HARMONY = ("<|channel|>analysis<|message|>Need the weather first.<|end|>"
+           "<|start|>assistant<|channel|>commentary to=functions.get_weather "
+           '<|constrain|>json<|message|>{"city": "Paris"}<|call|>'
+           "<|start|>assistant<|channel|>final<|message|>It is sunny.<|return|>")
+
+
+def test_harmony_reasoning_and_tools_full():
+    rp = get_reasoning_parser("gpt-oss-120b")
+    content, reasoning = rp.parse_full(HARMONY)
+    assert reasoning == "Need the weather first."
+    tp = get_tool_parser("gpt-oss-120b")
+    normal, calls = tp.parse_full(content)
+    assert normal == "It is sunny."
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+def test_harmony_streaming_pipeline_chunked():
+    for n in (1, 3, 8, 17):
+        rp = get_reasoning_parser("harmony")
+        tp = get_tool_parser("harmony")
+        reasoning = normal = ""
+        calls = []
+        for i in range(0, len(HARMONY), n):
+            d = rp.feed(HARMONY[i : i + n])
+            reasoning += d.reasoning
+            if d.content:
+                td = tp.feed(d.content)
+                normal += td.normal_text
+                calls += td.calls
+        d = rp.flush()
+        reasoning += d.reasoning
+        td = tp.feed(d.content) if d.content else None
+        if td:
+            normal += td.normal_text
+            calls += td.calls
+        td = tp.flush()
+        normal += td.normal_text
+        calls += td.calls
+        assert reasoning == "Need the weather first.", n
+        assert normal == "It is sunny.", n
+        assert [c.name for c in calls] == ["get_weather"], n
+        assert json.loads(calls[0].arguments) == {"city": "Paris"}, n
+
+
+def test_harmony_tool_on_analysis_channel():
+    """Recipient check wins over channel (reference parser.rs:124-129)."""
+    text = ("<|channel|>analysis to=functions.search <|constrain|>json"
+            '<|message|>{"q": "x"}<|call|>')
+    tp = get_tool_parser("harmony")
+    normal, calls = tp.parse_full(text)
+    assert calls and calls[0].name == "search"
+    # reasoning parser must ALSO route it as a tool frame, not reasoning
+    rp = get_reasoning_parser("harmony")
+    content, reasoning = rp.parse_full(text)
+    assert reasoning == ""
+    assert "functions.search" in content
+
+
+def test_parser_matrix_count():
+    """The dialect matrix matches the reference's 19-parser surface."""
+    from smg_tpu.parsers.tools import _PARSERS
+
+    names = set(_PARSERS) | {"harmony"}
+    assert len(names) >= 18, sorted(names)
+
+
+def test_harmony_recipient_without_trailing_space():
+    """Recipient jammed against the next control token still parses
+    (gpt-oss emits both spacings)."""
+    text = ('<|channel|>commentary to=functions.search<|constrain|>json'
+            '<|message|>{"q": "x"}<|call|>')
+    tp = get_tool_parser("harmony")
+    _, calls = tp.parse_full(text)
+    assert calls and calls[0].name == "search"
+
+
+def test_qwen_xml_numeric_entities():
+    text = ("<tool_call>\n<function=f>\n<parameter=s>\nit&#39;s &#x26; ok\n"
+            "</parameter>\n</function>\n</tool_call>")
+    p = get_tool_parser("qwen_xml")
+    _, calls = p.parse_full(text)
+    assert json.loads(calls[0].arguments)["s"] == "it's & ok"
