@@ -184,7 +184,7 @@ async def _run_gateway(args) -> int:
     mesh_node = None
     if getattr(args, "mesh_port", None) is not None:
         from smg_tpu.mesh import GossipConfig, GossipNode
-        from smg_tpu.mesh.adapters import WorkerSyncAdapter
+        from smg_tpu.mesh.adapters import TreeSyncAdapter, WorkerSyncAdapter
 
         mesh_node = GossipNode(
             GossipConfig(host="0.0.0.0", port=args.mesh_port,
@@ -192,6 +192,7 @@ async def _run_gateway(args) -> int:
         )
         await mesh_node.start()
         WorkerSyncAdapter(ctx.registry, mesh_node.state)
+        TreeSyncAdapter(ctx.policies, mesh_node.state)
         logger.info("HA mesh enabled on port %d", args.mesh_port)
 
     app = build_app(ctx)

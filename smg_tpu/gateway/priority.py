@@ -11,7 +11,6 @@ queues.
 from __future__ import annotations
 
 import asyncio
-import time
 from dataclasses import dataclass, field
 
 CLASS_ORDER = ("system", "interactive", "default", "bulk")
@@ -25,6 +24,12 @@ class PriorityConfig:
                                                      "interactive": 1024, "system": 256})
     max_wait_secs: dict = field(default_factory=lambda: {"bulk": 120.0, "default": 30.0,
                                                          "interactive": 10.0, "system": 5.0})
+    # Preemption (reference: middleware/scheduler/engine.rs 50ms-budget
+    # preemption): requests of `preempt_for` classes that stay queued past
+    # `preempt_after_secs` cancel+requeue one in-flight `preemptable` request.
+    preempt_for: tuple[str, ...] = ("system",)
+    preemptable: tuple[str, ...] = ("bulk",)
+    preempt_after_secs: float = 0.05
 
 
 class AdmissionRejected(Exception):
@@ -34,13 +39,23 @@ class AdmissionRejected(Exception):
 
 
 class SlotGuard:
-    def __init__(self, scheduler: "PriorityScheduler"):
+    def __init__(self, scheduler: "PriorityScheduler", priority: str = "default"):
         self._sched = scheduler
         self._released = False
+        self.priority = priority
+        self.preempted = False
+        self._preempt_cb = None
+
+    def set_preempt_callback(self, cb) -> None:
+        """Opt this in-flight request into preemption: ``cb()`` must cancel
+        the request's work, which in turn releases this guard."""
+        self._preempt_cb = cb
+        self._sched._register_preemptable(self)
 
     def release(self) -> None:
         if not self._released:
             self._released = True
+            self._sched._unregister_preemptable(self)
             self._sched._release()
 
     async def __aenter__(self):
@@ -57,7 +72,13 @@ class PriorityScheduler:
         self._waiters: dict[str, asyncio.Queue] = {}
         self._queues: dict[str, list] = {c: [] for c in self.config.classes}
         self._lock = asyncio.Lock()
-        self.stats = {c: {"admitted": 0, "rejected": 0} for c in self.config.classes}
+        self._preemptable: dict[str, list[SlotGuard]] = {
+            c: [] for c in self.config.classes
+        }
+        self.stats = {
+            c: {"admitted": 0, "rejected": 0, "preempted": 0}
+            for c in self.config.classes
+        }
 
     def classify(self, headers) -> str:
         c = (headers.get("X-SMG-Priority") or headers.get("Priority") or "default").lower()
@@ -65,18 +86,24 @@ class PriorityScheduler:
 
     async def admit(self, priority: str = "default") -> SlotGuard:
         """Waits for a slot; raises AdmissionRejected on queue overflow or
-        wait timeout."""
+        wait timeout.  Waiters of ``preempt_for`` classes that exceed the
+        preemption budget cancel one in-flight ``preemptable`` request."""
         async with self._lock:
             if self._free > 0 and not any(self._queues[c] for c in self.config.classes):
                 self._free -= 1
                 self.stats[priority]["admitted"] += 1
-                return SlotGuard(self)
+                return SlotGuard(self, priority)
             if len(self._queues[priority]) >= self.config.max_queue.get(priority, 1024):
                 self.stats[priority]["rejected"] += 1
                 raise AdmissionRejected(f"{priority} queue full")
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._queues[priority].append(fut)
         timeout = self.config.max_wait_secs.get(priority, 30.0)
+        preempt_task = None
+        if priority in self.config.preempt_for:
+            preempt_task = asyncio.get_running_loop().create_task(
+                self._preempt_when_stalled(fut)
+            )
         try:
             await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
@@ -94,8 +121,47 @@ class PriorityScheduler:
             if fut.done() and not fut.cancelled():
                 self._release()
             raise
+        finally:
+            if preempt_task is not None:
+                preempt_task.cancel()
         self.stats[priority]["admitted"] += 1
-        return SlotGuard(self)
+        return SlotGuard(self, priority)
+
+    # ---- preemption ----
+
+    def _register_preemptable(self, guard: SlotGuard) -> None:
+        if guard.priority in self.config.preemptable:
+            self._preemptable[guard.priority].append(guard)
+
+    def _unregister_preemptable(self, guard: SlotGuard) -> None:
+        q = self._preemptable.get(guard.priority)
+        if q and guard in q:
+            q.remove(guard)
+
+    async def _preempt_when_stalled(self, fut: asyncio.Future) -> None:
+        await asyncio.sleep(self.config.preempt_after_secs)
+        if fut.done():
+            return
+        # newest bulk work pays first (it has produced the least output)
+        for c in reversed(self.config.classes):
+            if c not in self.config.preemptable:
+                continue
+            victims = self._preemptable.get(c) or []
+            for guard in reversed(victims):
+                if guard.preempted or guard._preempt_cb is None:
+                    continue
+                # mark BEFORE the callback (task.cancel only schedules the
+                # cancellation; the handler must already see preempted=True),
+                # but roll back if the callback itself fails so the guard
+                # stays eligible and stats stay truthful
+                guard.preempted = True
+                try:
+                    guard._preempt_cb()
+                except Exception:
+                    guard.preempted = False
+                    continue
+                self.stats[c]["preempted"] += 1
+                return
 
     def _release(self) -> None:
         loop = asyncio.get_event_loop()

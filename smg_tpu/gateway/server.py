@@ -111,6 +111,8 @@ def _sse_response(request: web.Request) -> web.StreamResponse:
             "X-Accel-Buffering": "no",
         },
     )
+    # once prepared, bytes go out — a preempted request can no longer requeue
+    request["response_started"] = True
     return resp
 
 
@@ -181,10 +183,53 @@ async def admission_middleware(request: web.Request, handler):
     ctx.metrics.queue_wait.labels(priority=priority).observe(_time.perf_counter() - q_start)
     try:
         with ctx.metrics.track_request(request.path):
-            return await handler(request)
+            if priority not in ctx.priority.config.preemptable:
+                return await handler(request)
+            return await _run_preemptable(ctx, request, handler, guard, priority)
     finally:
         guard.release()
         ctx.rate_limiter.release(tenant)
+
+
+async def _run_preemptable(ctx, request, handler, guard, priority: str):
+    """Run a preemptable-class request so a stalled high-priority waiter can
+    cancel it (reference: scheduler/engine.rs preemption under a 50ms
+    budget).  Cancel+requeue: if no response bytes have gone out, the request
+    re-queues through admission and runs again; an already-streaming response
+    cannot be replayed, so its connection terminates."""
+    from smg_tpu.gateway.priority import AdmissionRejected
+
+    while True:
+        task = asyncio.ensure_future(handler(request))
+        guard.set_preempt_callback(task.cancel)
+        try:
+            return await task
+        except asyncio.CancelledError:
+            if not guard.preempted:
+                # client disconnect / shutdown: propagate into the handler so
+                # its work doesn't outlive the slot
+                task.cancel()
+                try:
+                    await task
+                except BaseException:
+                    pass
+                raise
+            if request.get("response_started"):
+                raise  # mid-stream: nothing to replay
+            # requeue: give the slot back, wait in our class queue, run again
+            guard.release()
+            try:
+                new_guard = await ctx.priority.admit(priority)
+            except AdmissionRejected as e:
+                return _error(503, f"preempted and requeue failed: {e}",
+                              "overloaded_error")
+            # adopt the fresh slot into the caller's finally-released guard
+            # (slots are fungible counters, so transferring ownership is just
+            # re-arming the old guard and disarming the new one)
+            guard._released = False
+            guard.preempted = False
+            guard._preempt_cb = None
+            new_guard._released = True  # ownership moved
 
 
 def build_app(ctx: AppContext) -> web.Application:

@@ -89,3 +89,101 @@ class WorkerSyncAdapter:
             )
         )
         logger.info("mesh: registered remote worker %s (%s)", wid, value["url"])
+
+
+TREE_NS = "tree/"
+_MAX_SYNC_TOKENS = 256  # bound gossip payloads; long prefixes truncate
+
+
+class TreeSyncAdapter:
+    """Replicates cache_aware routed-prefix inserts between gateway peers.
+
+    Reference: ``mesh/adapters/tree_sync.rs`` — ``td:{model}`` gossip stream
+    carrying prefix-tree deltas so every peer's approximate tree knows which
+    worker holds which prefix, keeping cache-aware routing sticky across a
+    gateway fleet.  CRDT key = ``tree/{model}/{prefix-hash}``; value carries
+    the (bounded) sequence + worker attribution; LWW merge resolves races the
+    same way the local tree does (last router wins).
+
+    Policies are created lazily per model, so the adapter registers a
+    creation hook on the PolicyRegistry instead of snapshotting; on creation
+    it also replays any tree state already gossiped for that model.  Gossip
+    for models this gateway does not serve is ignored (no policy is
+    materialized for it)."""
+
+    def __init__(self, policies, state: LwwMap):
+        self.policies = policies
+        self.state = state
+        self._applying_remote = False
+        self._publishing = False
+        state.on_change(self._on_state_change)
+        policies.add_create_hook(self._on_policy_created)
+
+    def _on_policy_created(self, model_id: str | None, policy) -> None:
+        from smg_tpu.policies.cache_aware import CacheAwarePolicy
+
+        if not isinstance(policy, CacheAwarePolicy):
+            return
+        key_model = model_id or "__default__"
+        policy.add_insert_hook(
+            lambda seq, wid, m=key_model: self._publish(m, seq, wid)
+        )
+        # replay tree state peers gossiped before this policy existed
+        prefix = f"{TREE_NS}{key_model}/"
+        for key, value in self.state.items().items():
+            if key.startswith(prefix):
+                self._apply(policy, value)
+
+    # ---- local -> mesh ----
+
+    def _publish(self, model: str, seq, worker_id: str) -> None:
+        if self._applying_remote:
+            return
+        import hashlib
+
+        if isinstance(seq, str):
+            payload, kind = seq[: _MAX_SYNC_TOKENS * 4], "str"
+        else:
+            payload, kind = list(seq)[:_MAX_SYNC_TOKENS], "tokens"
+        digest = hashlib.blake2b(
+            repr(payload).encode(), digest_size=12
+        ).hexdigest()
+        # LwwMap.set notifies local listeners synchronously: the flag stops
+        # the publish from echoing back into apply on the routing hot path
+        self._publishing = True
+        try:
+            self.state.set(
+                f"{TREE_NS}{model}/{digest}",
+                {"kind": kind, "seq": payload, "worker": worker_id},
+            )
+        finally:
+            self._publishing = False
+
+    # ---- mesh -> local ----
+
+    def _on_state_change(self, key: str, value, deleted: bool) -> None:
+        if self._publishing:
+            return  # our own set() echoing back
+        if not key.startswith(TREE_NS) or deleted or not isinstance(value, dict):
+            return
+        model = key[len(TREE_NS):].rsplit("/", 1)[0]
+        model_id = None if model == "__default__" else model
+        # only mirror into models this gateway actually serves — peers may
+        # gossip trees for models we have no policy (or workers) for
+        if not self.policies.has_policy(model_id):
+            return
+        self._apply(self.policies.policy_for(model_id), value)
+
+    def _apply(self, policy, value: dict) -> None:
+        from smg_tpu.policies.cache_aware import CacheAwarePolicy
+
+        if not isinstance(policy, CacheAwarePolicy) or not isinstance(value, dict):
+            return
+        seq = value.get("seq")
+        if value.get("kind") == "tokens" and isinstance(seq, list):
+            seq = [int(t) for t in seq]
+        self._applying_remote = True
+        try:
+            policy.apply_remote_insert(seq, value.get("worker", ""))
+        finally:
+            self._applying_remote = False

@@ -76,15 +76,36 @@ class PolicyRegistry:
         self._default_name = default
         self._default_kwargs = default_kwargs
         self._per_model: dict[str, Policy] = {}
+        # fired with (model_id, policy) whenever a policy instance is created
+        # (mesh tree_sync attaches replication hooks here — policies are
+        # created lazily per model, so a one-shot snapshot would miss them)
+        self._create_hooks: list = []
+
+    def add_create_hook(self, cb) -> None:
+        self._create_hooks.append(cb)
+        for key, policy in self._per_model.items():
+            cb(None if key == "__default__" else key, policy)
+
+    def _created(self, model_id: str | None, policy: Policy) -> None:
+        for cb in self._create_hooks:
+            try:
+                cb(model_id, policy)
+            except Exception:
+                pass
+
+    def has_policy(self, model_id: str | None) -> bool:
+        return (model_id or "__default__") in self._per_model
 
     def policy_for(self, model_id: str | None) -> Policy:
         key = model_id or "__default__"
         if key not in self._per_model:
             self._per_model[key] = get_policy(self._default_name, **self._default_kwargs)
+            self._created(model_id, self._per_model[key])
         return self._per_model[key]
 
     def set_policy(self, model_id: str, name: str, **kwargs) -> None:
         self._per_model[model_id] = get_policy(name, **kwargs)
+        self._created(model_id, self._per_model[model_id])
 
     def on_worker_removed(self, worker_id: str) -> None:
         for p in self._per_model.values():

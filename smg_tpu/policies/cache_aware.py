@@ -48,6 +48,9 @@ class CacheAwarePolicy(Policy):
 
         self.tree = make_radix_tree(max_tree_size)
         self.indexer = PositionalIndexer(page_size=page_size)
+        # mesh replication hooks (tree_sync): fired on local routed-prefix
+        # inserts so peers can mirror them; remote applies bypass the hooks
+        self._insert_hooks: list = []
         self._rng = _random.Random(seed)
 
     # event-mode feed (wired to KvEventMonitor)
@@ -95,4 +98,19 @@ class CacheAwarePolicy(Policy):
             chosen = self._rng.choice(cands)
         if self.mode != "event" and seq is not None and len(seq) > 0:
             self.tree.insert(seq, chosen.worker_id)
+            for hook in self._insert_hooks:
+                try:
+                    hook(seq, chosen.worker_id)
+                except Exception:  # replication must never fail routing
+                    pass
         return chosen
+
+    # ---- mesh tree_sync surface (reference: mesh/adapters/tree_sync.rs) ----
+
+    def add_insert_hook(self, cb) -> None:
+        self._insert_hooks.append(cb)
+
+    def apply_remote_insert(self, seq, worker_id: str) -> None:
+        """Insert a peer-routed prefix without re-firing replication hooks."""
+        if self.mode != "event" and seq is not None and len(seq) > 0:
+            self.tree.insert(seq, worker_id)

@@ -225,3 +225,137 @@ def test_health_monitor_marks_dead_worker(secured_gateway):
         return healthy
 
     assert secured_gateway.run(go()) is False
+
+
+# ---- priority preemption (reference: scheduler/engine.rs 50ms budget) ----
+
+
+def test_priority_preemption_scheduler_level():
+    """A system-class waiter stalled past the budget cancels the newest
+    in-flight bulk request, which releases its slot to the waiter."""
+    import asyncio
+
+    from smg_tpu.gateway.priority import PriorityConfig, PriorityScheduler
+
+    async def go():
+        sched = PriorityScheduler(PriorityConfig(
+            slots=1, preempt_after_secs=0.03,
+        ))
+        cancelled = asyncio.Event()
+
+        bulk_guard = await sched.admit("bulk")
+
+        async def bulk_work():
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                cancelled.set()
+                bulk_guard.release()
+                raise
+
+        bulk_task = asyncio.get_running_loop().create_task(bulk_work())
+        bulk_guard.set_preempt_callback(bulk_task.cancel)
+        await asyncio.sleep(0)  # let bulk start
+
+        t0 = asyncio.get_running_loop().time()
+        sys_guard = await sched.admit("system")
+        waited = asyncio.get_running_loop().time() - t0
+        assert cancelled.is_set(), "bulk work was not preempted"
+        assert bulk_guard.preempted
+        assert waited < 5.0
+        assert sched.stats["bulk"]["preempted"] == 1
+        sys_guard.release()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_priority_no_preemption_within_budget():
+    """A slot freed inside the budget means no preemption happens."""
+    import asyncio
+
+    from smg_tpu.gateway.priority import PriorityConfig, PriorityScheduler
+
+    async def go():
+        sched = PriorityScheduler(PriorityConfig(slots=1, preempt_after_secs=0.2))
+        bulk_guard = await sched.admit("bulk")
+        bulk_guard.set_preempt_callback(lambda: (_ for _ in ()).throw(AssertionError))
+
+        async def free_soon():
+            await asyncio.sleep(0.02)
+            bulk_guard.release()
+
+        asyncio.get_running_loop().create_task(free_soon())
+        sys_guard = await sched.admit("system")
+        await asyncio.sleep(0.3)  # budget elapses; preempt task must be dead
+        assert not bulk_guard.preempted
+        assert sched.stats["bulk"]["preempted"] == 0
+        sys_guard.release()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_preemption_requeue_through_gateway():
+    """Middleware-level cancel+requeue: a bulk request that hasn't started
+    responding is cancelled for a system request, requeues, and completes."""
+    import asyncio
+    import threading
+
+    from aiohttp import web as aioweb
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from smg_tpu.gateway.priority import PriorityConfig
+    from smg_tpu.gateway.server import AppContext, build_app
+
+    ctx = AppContext(
+        policy="round_robin",
+        priority_config=PriorityConfig(slots=1, preempt_after_secs=0.03),
+    )
+    app = build_app(ctx)
+    state = {"bulk_runs": 0}
+
+    async def slow_bulk_handler(request):
+        state["bulk_runs"] += 1
+        await asyncio.sleep(0.4)
+        return aioweb.json_response({"run": state["bulk_runs"]})
+
+    async def fast_handler(request):
+        return aioweb.json_response({"ok": True})
+
+    # override the chat route with controllable handlers (path must be in
+    # INFERENCE_ROUTES so the admission middleware engages)
+    app2 = aioweb.Application(middlewares=app.middlewares)
+    app2["ctx"] = ctx
+    app2.router.add_post("/v1/chat/completions", slow_bulk_handler)
+    app2.router.add_post("/v1/completions", fast_handler)
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=30)
+
+    async def go():
+        tc = TestClient(TestServer(app2))
+        await tc.start_server()
+        bulk_fut = asyncio.ensure_future(tc.post(
+            "/v1/chat/completions", json={}, headers={"X-SMG-Priority": "bulk"},
+        ))
+        await asyncio.sleep(0.05)  # bulk is in-flight, holding the only slot
+        r_sys = await tc.post(
+            "/v1/completions", json={}, headers={"X-SMG-Priority": "system"},
+        )
+        sys_body = await r_sys.json()
+        r_bulk = await bulk_fut
+        bulk_body = await r_bulk.json()
+        await tc.close()
+        return r_sys.status, sys_body, r_bulk.status, bulk_body
+
+    try:
+        s_status, s_body, b_status, b_body = run(go())
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+    assert s_status == 200 and s_body == {"ok": True}
+    assert b_status == 200
+    assert b_body["run"] == 2, b_body  # first run cancelled, second completed
+    assert ctx.priority.stats["bulk"]["preempted"] == 1

@@ -113,3 +113,49 @@ def test_worker_sync_adapter():
     reg_a.remove("w-local")
     state_b.merge(state_a.snapshot())
     assert reg_b.get("w-local") is None
+
+
+def test_tree_sync_replicates_routed_prefixes():
+    """A prefix routed on gateway A makes gateway B's cache_aware policy
+    route the same prefix to the same worker (reference:
+    mesh/adapters/tree_sync.rs, 2-node in-proc)."""
+    from smg_tpu.gateway.workers import Worker
+    from smg_tpu.gateway.worker_client import WorkerClient
+    from smg_tpu.mesh.adapters import TreeSyncAdapter
+    from smg_tpu.policies.base import PolicyRegistry, RequestContext
+
+    class FakeClient(WorkerClient):
+        pass
+
+    def mk_workers():
+        return [
+            Worker(worker_id=f"w{i}", client=FakeClient(), model_id="m")
+            for i in range(4)
+        ]
+
+    state_a, state_b = LwwMap("ga"), LwwMap("gb")
+    pol_a = PolicyRegistry(default="cache_aware", seed=1)
+    pol_b = PolicyRegistry(default="cache_aware", seed=2)
+    TreeSyncAdapter(pol_a, state_a)
+    TreeSyncAdapter(pol_b, state_b)
+
+    workers_a, workers_b = mk_workers(), mk_workers()
+    prefix = list(range(100, 164))
+    ctx = RequestContext(token_ids=prefix)
+    chosen = pol_a.policy_for(None).select_worker(workers_a, ctx)
+    assert chosen is not None
+
+    # gossip round: B merges A's state
+    state_b.merge(state_a.snapshot())
+
+    # B routes the same prefix (plus continuation) to the SAME worker even
+    # though its local tree never saw the request
+    ctx2 = RequestContext(token_ids=prefix + list(range(164, 180)))
+    chosen_b = pol_b.policy_for(None).select_worker(workers_b, ctx2)
+    assert chosen_b is not None
+    assert chosen_b.worker_id == chosen.worker_id
+
+    # and B's own follow-up inserts replicate back to A
+    state_a.merge(state_b.snapshot())
+    matches = pol_a.policy_for(None).tree.prefix_match(ctx2.token_ids)
+    assert matches.get(chosen.worker_id, 0) >= len(prefix)
