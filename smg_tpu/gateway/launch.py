@@ -151,11 +151,26 @@ async def _run_gateway(args) -> int:
         + [(u, WorkerType.PREFILL) for u in getattr(args, "prefill_workers", [])]
         + [(u, WorkerType.DECODE) for u in getattr(args, "decode_workers", [])]
     )
-    for url, wtype in role_urls:
+    async def _register_worker(url: str, wtype, deadline: float) -> None:
+        """Register one worker, retrying within the shared startup budget —
+        a worker still starting up must not kill (or serialize) the gateway
+        (reference: worker_startup_timeout_secs)."""
         from smg_tpu.rpc.client import GrpcWorkerClient
 
         client = GrpcWorkerClient(url)
-        info = await client.get_model_info()
+        info = None
+        while True:
+            try:
+                info = await client.get_model_info()
+                break
+            except Exception as e:
+                if asyncio.get_event_loop().time() >= deadline:
+                    logger.error("worker %s unreachable at startup: %s; skipping", url, e)
+                    break
+                await asyncio.sleep(1.0)
+        if info is None:
+            await client.close()
+            return
         model_id = info.get("model_id", "default")
         ctx.registry.add(
             Worker(
@@ -176,6 +191,12 @@ async def _run_gateway(args) -> int:
                     model_id, tok, default=ctx.tokenizers.get(None) is None
                 )
                 logger.info("tokenizer for %r fetched from worker %s", model_id, url)
+
+    if role_urls:
+        startup_deadline = asyncio.get_event_loop().time() + 30.0
+        await asyncio.gather(
+            *(_register_worker(url, wtype, startup_deadline) for url, wtype in role_urls)
+        )
 
     if args.command == "launch" and ctx.tokenizers.get(None) is None:
         # nothing explicit and no worker handed one over: mock fallback

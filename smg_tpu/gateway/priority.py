@@ -84,14 +84,17 @@ class PriorityScheduler:
         c = (headers.get("X-SMG-Priority") or headers.get("Priority") or "default").lower()
         return c if c in self.config.classes else "default"
 
-    async def admit(self, priority: str = "default") -> SlotGuard:
+    async def admit(self, priority: str = "default", count_stats: bool = True) -> SlotGuard:
         """Waits for a slot; raises AdmissionRejected on queue overflow or
         wait timeout.  Waiters of ``preempt_for`` classes that exceed the
-        preemption budget cancel one in-flight ``preemptable`` request."""
+        preemption budget cancel one in-flight ``preemptable`` request.
+        ``count_stats=False`` (preemption requeues) keeps one logical request
+        from inflating the admitted counter."""
         async with self._lock:
             if self._free > 0 and not any(self._queues[c] for c in self.config.classes):
                 self._free -= 1
-                self.stats[priority]["admitted"] += 1
+                if count_stats:
+                    self.stats[priority]["admitted"] += 1
                 return SlotGuard(self, priority)
             if len(self._queues[priority]) >= self.config.max_queue.get(priority, 1024):
                 self.stats[priority]["rejected"] += 1
@@ -124,7 +127,8 @@ class PriorityScheduler:
         finally:
             if preempt_task is not None:
                 preempt_task.cancel()
-        self.stats[priority]["admitted"] += 1
+        if count_stats:
+            self.stats[priority]["admitted"] += 1
         return SlotGuard(self, priority)
 
     # ---- preemption ----
@@ -156,8 +160,11 @@ class PriorityScheduler:
                 # stays eligible and stats stay truthful
                 guard.preempted = True
                 try:
-                    guard._preempt_cb()
+                    ok = guard._preempt_cb()
                 except Exception:
+                    guard.preempted = False
+                    continue
+                if ok is False:  # task.cancel() no-op: victim already done
                     guard.preempted = False
                     continue
                 self.stats[c]["preempted"] += 1

@@ -199,9 +199,18 @@ async def _run_preemptable(ctx, request, handler, guard, priority: str):
     cannot be replayed, so its connection terminates."""
     from smg_tpu.gateway.priority import AdmissionRejected
 
+    # cache the full body BEFORE the handler can be cancelled: aiohttp only
+    # caches a COMPLETE read, so a cancel mid-request.json() would leave the
+    # retry reading a half-consumed payload stream
+    await request.read()
+    requeues = 0
     while True:
         task = asyncio.ensure_future(handler(request))
-        guard.set_preempt_callback(task.cancel)
+        if requeues == 0:
+            # a request that already paid one preemption runs to completion
+            # (immunity bounds wasted work and guarantees progress — no
+            # livelock under sustained system-class pressure)
+            guard.set_preempt_callback(task.cancel)
         try:
             return await task
         except asyncio.CancelledError:
@@ -219,7 +228,7 @@ async def _run_preemptable(ctx, request, handler, guard, priority: str):
             # requeue: give the slot back, wait in our class queue, run again
             guard.release()
             try:
-                new_guard = await ctx.priority.admit(priority)
+                new_guard = await ctx.priority.admit(priority, count_stats=False)
             except AdmissionRejected as e:
                 return _error(503, f"preempted and requeue failed: {e}",
                               "overloaded_error")
@@ -230,6 +239,7 @@ async def _run_preemptable(ctx, request, handler, guard, priority: str):
             guard.preempted = False
             guard._preempt_cb = None
             new_guard._released = True  # ownership moved
+            requeues += 1
 
 
 def build_app(ctx: AppContext) -> web.Application:
@@ -956,10 +966,30 @@ async def h_workers_add(request: web.Request) -> web.Response:
 
 
 async def h_workers_remove(request: web.Request) -> web.Response:
+    """Remove a worker, draining in-flight requests first (reference:
+    ``--drain-settle-secs``, main.rs:550-556).  ``?drain=SECS`` bounds the
+    wait (default 10, 0 = immediate); the worker stops receiving new
+    selections the moment draining starts."""
     ctx: AppContext = request.app["ctx"]
     wid = request.match_info["worker_id"]
-    worker = ctx.registry.remove(wid)
+    worker = ctx.registry.get(wid)
     if worker is None:
         return _error(404, f"no such worker {wid}")
+    try:
+        drain_secs = float(request.query.get("drain", "10"))
+    except ValueError:
+        return _error(400, "invalid drain seconds")
+    if not (0.0 <= drain_secs <= 300.0):
+        return _error(400, "drain seconds must be in [0, 300]")
+    if worker.draining:
+        return _error(409, f"worker {wid} is already draining")
+    worker.draining = True
+    deadline = asyncio.get_running_loop().time() + drain_secs
+    while worker.load > 0 and asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.05)
+    drained = worker.load == 0
+    ctx.registry.remove(wid)
     await worker.client.close()
-    return web.json_response({"removed": wid})
+    return web.json_response(
+        {"removed": wid, "drained": drained, "in_flight_at_removal": worker.load}
+    )

@@ -111,6 +111,7 @@ class Worker:
         self.page_size = page_size  # engine KV page size (cache_aware event mode)
         self.circuit = CircuitBreaker()
         self.healthy = True
+        self.draining = False  # drain-before-remove: no new selections
         self._load = 0
         self._lock = threading.Lock()
         self.registered_at = time.time()
@@ -122,7 +123,7 @@ class Worker:
         return self._load
 
     def is_available(self) -> bool:
-        return self.healthy and self.circuit.allow()
+        return self.healthy and not self.draining and self.circuit.allow()
 
     def acquire(self) -> "WorkerLoadGuard":
         return WorkerLoadGuard(self)
@@ -143,6 +144,7 @@ class Worker:
             "type": self.worker_type.value,
             "url": self.url,
             "healthy": self.healthy,
+            "draining": self.draining,
             "circuit": self.circuit.state.value,
             "load": self.load,
             "total_requests": self.total_requests,

@@ -111,11 +111,18 @@ class TreeSyncAdapter:
     for models this gateway does not serve is ignored (no policy is
     materialized for it)."""
 
-    def __init__(self, policies, state: LwwMap):
+    def __init__(self, policies, state: LwwMap, max_entries: int = 4096):
         self.policies = policies
         self.state = state
         self._applying_remote = False
         self._publishing = False
+        # bound locally-published entries (LRU): the radix tree evicts, so
+        # mesh state must too — evictions tombstone the CRDT key and
+        # replicate as deletes to peers
+        from collections import OrderedDict
+
+        self._published: OrderedDict[str, None] = OrderedDict()
+        self._max_entries = max_entries
         state.on_change(self._on_state_change)
         policies.add_create_hook(self._on_policy_created)
 
@@ -150,12 +157,17 @@ class TreeSyncAdapter:
         ).hexdigest()
         # LwwMap.set notifies local listeners synchronously: the flag stops
         # the publish from echoing back into apply on the routing hot path
+        key = f"{TREE_NS}{model}/{digest}"
         self._publishing = True
         try:
             self.state.set(
-                f"{TREE_NS}{model}/{digest}",
-                {"kind": kind, "seq": payload, "worker": worker_id},
+                key, {"kind": kind, "seq": payload, "worker": worker_id}
             )
+            self._published[key] = None
+            self._published.move_to_end(key)
+            while len(self._published) > self._max_entries:
+                old, _ = self._published.popitem(last=False)
+                self.state.delete(old)
         finally:
             self._publishing = False
 

@@ -1,0 +1,205 @@
+"""Reliability e2e: worker death mid-stream and drain-before-remove
+(reference: tier-2 reliability tests, model_gateway/tests/ + the
+--drain-settle-secs removal semantics, main.rs:550-556)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient
+from smg_tpu.gateway.workers import CircuitBreaker, Worker
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def make_engine() -> Engine:
+    return Engine(
+        EngineConfig(
+            model=tiny_test_config(),
+            cache=CacheConfig(page_size=16, num_pages=128, auto_size=False, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+                prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4,),
+            ),
+            dtype="float32",
+            model_id="tiny-test",
+        )
+    )
+
+
+class DyingClient(InProcWorkerClient):
+    """Streams a couple of chunks then dies (simulated worker crash)."""
+
+    def __init__(self, engine, die_after_chunks: int = 2):
+        super().__init__(engine)
+        self.die_after = die_after_chunks
+        self.dead = False
+
+    async def generate(self, req):
+        n = 0
+        async for chunk in super().generate(req):
+            yield chunk
+            n += 1
+            if n >= self.die_after:
+                self.dead = True
+                raise ConnectionError("worker process died mid-stream")
+
+    async def health(self) -> bool:
+        return not self.dead and await super().health()
+
+
+class SlowClient(InProcWorkerClient):
+    """Adds per-chunk latency so requests stay in flight during a drain."""
+
+    def __init__(self, engine, delay: float = 0.08):
+        super().__init__(engine)
+        self.delay = delay
+
+    async def generate(self, req):
+        async for chunk in super().generate(req):
+            await asyncio.sleep(self.delay)
+            yield chunk
+
+
+def _gateway(workers):
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+
+    async def _setup():
+        for w in workers:
+            ctx.registry.add(w)
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=60):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    tc = run(_setup())
+    return loop, ctx, tc, run
+
+
+def test_worker_dies_mid_stream_clean_error_and_heal():
+    """Worker dies mid-SSE: the client sees streamed tokens, then ONE clean
+    terminal error frame (no hang, no truncated garbage); the breaker opens
+    and later requests route around the dead worker."""
+    eng_a, eng_b = make_engine(), make_engine()
+    dying = DyingClient(eng_a, die_after_chunks=1)
+    w0 = Worker(worker_id="w0", client=dying, model_id="tiny-test")
+    w0.circuit = CircuitBreaker(failure_threshold=1, cooldown_secs=300.0)
+    w1 = Worker(worker_id="w1", client=InProcWorkerClient(eng_b), model_id="tiny-test")
+    loop, ctx, tc, run = _gateway([w0, w1])
+    try:
+        async def stream_until_dead():
+            # round_robin may pick w1 first; loop until the dying worker is hit
+            for _ in range(4):
+                r = await tc.post("/v1/chat/completions", json={
+                    "model": "tiny-test",
+                    "messages": [{"role": "user", "content": "w5 w6"}],
+                    "max_tokens": 8, "temperature": 0, "ignore_eos": True,
+                    "stream": True,
+                })
+                text = await r.text()
+                if dying.dead:
+                    return text
+            return None
+
+        raw = run(stream_until_dead())
+        assert raw is not None, "dying worker was never selected"
+        frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+        parsed = [json.loads(f) for f in frames if f != "[DONE]"]
+        # streamed at least one real token chunk, then a terminal error frame
+        assert any("choices" in p for p in parsed), frames
+        assert "error" in parsed[-1], frames[-3:]
+        assert w0.circuit.state.value == "open"
+        assert w0.total_failures >= 1
+
+        async def after():
+            results = []
+            for _ in range(4):
+                r = await tc.post("/v1/chat/completions", json={
+                    "model": "tiny-test",
+                    "messages": [{"role": "user", "content": "w9"}],
+                    "max_tokens": 3, "temperature": 0, "ignore_eos": True,
+                })
+                results.append(r.status)
+            return results
+
+        # registry heals: every subsequent request routes around w0
+        assert run(after()) == [200, 200, 200, 200]
+        assert w1.total_requests >= 4
+    finally:
+        run(tc.close())
+        loop.call_soon_threadsafe(loop.stop)
+        eng_a.stop(); eng_b.stop()
+
+
+def test_drain_before_remove():
+    """DELETE /workers/{id}?drain=N lets in-flight streams finish: the
+    draining worker takes no new requests, the live stream completes
+    cleanly, and removal reports drained=true."""
+    eng_a, eng_b = make_engine(), make_engine()
+    slow = SlowClient(eng_a, delay=0.06)
+    w0 = Worker(worker_id="w0", client=slow, model_id="tiny-test")
+    w1 = Worker(worker_id="w1", client=InProcWorkerClient(eng_b), model_id="tiny-test")
+    loop, ctx, tc, run = _gateway([w0, w1])
+    try:
+        async def go():
+            # occupy w0 with a slow stream (round_robin: find it)
+            stream_task = None
+            for _ in range(4):
+                t = asyncio.ensure_future(tc.post("/v1/chat/completions", json={
+                    "model": "tiny-test",
+                    "messages": [{"role": "user", "content": "w5 w6"}],
+                    "max_tokens": 10, "temperature": 0, "ignore_eos": True,
+                    "stream": True,
+                }))
+                await asyncio.sleep(0.15)
+                if w0.load > 0:
+                    stream_task = t
+                    break
+                (await t).close()
+            assert stream_task is not None, "slow worker never selected"
+
+            # remove with drain while the stream is live
+            del_task = asyncio.ensure_future(
+                tc.delete("/workers/w0", params={"drain": "10"})
+            )
+            await asyncio.sleep(0.1)
+            assert w0.draining
+            # new requests during the drain land on w1 only
+            r = await tc.post("/v1/chat/completions", json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "w7"}],
+                "max_tokens": 2, "temperature": 0, "ignore_eos": True,
+            })
+            assert r.status == 200
+            assert w1.total_requests >= 1
+
+            resp = await stream_task
+            raw = await resp.text()
+            del_resp = await del_task
+            del_body = await del_resp.json()
+            return raw, del_body
+
+        raw, del_body = run(go())
+        frames = [l for l in raw.splitlines() if l.startswith("data: ")]
+        assert frames[-1] == "data: [DONE]"  # the in-flight stream finished
+        assert len([f for f in frames if "choices" in f]) >= 10
+        assert del_body["removed"] == "w0"
+        assert del_body["drained"] is True
+        assert ctx.registry.get("w0") is None
+    finally:
+        run(tc.close())
+        loop.call_soon_threadsafe(loop.stop)
+        eng_a.stop(); eng_b.stop()
