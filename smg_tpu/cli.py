@@ -76,6 +76,25 @@ def main(argv: list[str] | None = None) -> int:
     from smg_tpu.utils.logging import configure
 
     configure(level=getattr(args, "log_level", "INFO"))
+    # validate before any port binds or chip touches (reference:
+    # ConfigValidator::validate at startup, config/validation.rs)
+    if args.command in ("launch", "serve"):
+        from smg_tpu.config import validate_gateway_config
+        from smg_tpu.config.validation import raise_on_errors
+        from smg_tpu.utils import get_logger
+
+        raise_on_errors(
+            validate_gateway_config(
+                policy=args.policy,
+                workers=args.workers,
+                prefill_workers=args.prefill_workers,
+                decode_workers=args.decode_workers,
+                max_concurrent_requests=args.max_concurrent_requests,
+                kv_connector=args.kv_connector,
+                mesh_port=args.mesh_port,
+            ),
+            logger=get_logger("config"),
+        )
     if args.command in ("launch", "serve", "worker"):
         from smg_tpu.gateway.launch import run_command
 

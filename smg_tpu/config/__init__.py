@@ -1,0 +1,13 @@
+from smg_tpu.config.validation import (
+    ConfigError,
+    ValidationIssue,
+    validate_engine_config,
+    validate_gateway_config,
+)
+
+__all__ = [
+    "ConfigError",
+    "ValidationIssue",
+    "validate_engine_config",
+    "validate_gateway_config",
+]

@@ -1,0 +1,204 @@
+"""Config validation layer — reject bad deployments at startup, not at the
+first request.
+
+Reference behavior: ``ConfigValidator``
+(``model_gateway/src/config/validation.rs``, validate_mode/policy/server/
+retry/circuit-breaker/compatibility) — every launch config passes a
+cross-field validation pass before anything binds a port or touches a chip.
+The TPU build extends it with mesh/model divisibility rules XLA would
+otherwise surface as inscrutable trace-time errors: tp vs heads, pp vs
+layers, sp vs prefill buckets, ep vs experts, page/bucket tiling.
+
+Two severities: ``error`` (raise ``ConfigError`` before startup) and
+``warn`` (log and continue — legal but probably not what you want, e.g. a
+decode-batch ladder whose largest rung is far below max_batch_size).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class ConfigError(ValueError):
+    """Invalid configuration; ``.issues`` carries every finding."""
+
+    def __init__(self, issues: "list[ValidationIssue]"):
+        self.issues = issues
+        msgs = "; ".join(str(i) for i in issues)
+        super().__init__(f"invalid configuration: {msgs}")
+
+
+@dataclass(frozen=True)
+class ValidationIssue:
+    severity: str  # "error" | "warn"
+    field: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.severity}] {self.field}: {self.message}"
+
+
+def _err(field: str, message: str) -> ValidationIssue:
+    return ValidationIssue("error", field, message)
+
+
+def _warn(field: str, message: str) -> ValidationIssue:
+    return ValidationIssue("warn", field, message)
+
+
+def validate_engine_config(cfg) -> list[ValidationIssue]:
+    """Validate an ``EngineConfig`` (model x parallel x cache x scheduler)."""
+    issues: list[ValidationIssue] = []
+    model = cfg.model
+    par = cfg.parallel
+    cache = cfg.cache
+    sched = cfg.scheduler
+
+    # ---- parallel x model divisibility (trace-time failures made legible)
+    if model is not None:
+        if par.tp > 1:
+            if model.num_heads % par.tp != 0:
+                issues.append(_err(
+                    "parallel.tp",
+                    f"tp={par.tp} does not divide num_heads={model.num_heads}",
+                ))
+            kv_lanes = model.num_kv_heads * model.head_dim
+            if kv_lanes % par.tp != 0:
+                issues.append(_err(
+                    "parallel.tp",
+                    f"tp={par.tp} does not divide kv lanes "
+                    f"(num_kv_heads*head_dim={kv_lanes})",
+                ))
+            if model.intermediate_size % par.tp != 0:
+                issues.append(_err(
+                    "parallel.tp",
+                    f"tp={par.tp} does not divide intermediate_size="
+                    f"{model.intermediate_size}",
+                ))
+        if par.pp > 1 and model.num_layers % par.pp != 0:
+            issues.append(_err(
+                "parallel.pp",
+                f"pp={par.pp} does not divide num_layers={model.num_layers}",
+            ))
+        if par.ep > 1:
+            if model.num_experts == 0:
+                issues.append(_err(
+                    "parallel.ep", f"ep={par.ep} on a dense (non-MoE) model"
+                ))
+            elif model.num_experts % par.ep != 0:
+                issues.append(_err(
+                    "parallel.ep",
+                    f"ep={par.ep} does not divide num_experts={model.num_experts}",
+                ))
+    if par.sp > 1:
+        bad = [b for b in sched.prefill_token_buckets if b % par.sp != 0]
+        if bad:
+            issues.append(_warn(
+                "scheduler.prefill_token_buckets",
+                f"buckets {bad} not divisible by sp={par.sp}: those prefills "
+                f"fall back to the dense (non-ring) path",
+            ))
+
+    # ---- cache / scheduler coherence
+    if not cache.auto_size:
+        min_pages = sched.watermark_pages + 2  # garbage page + one working page
+        if cache.num_pages < min_pages:
+            issues.append(_err(
+                "cache.num_pages",
+                f"{cache.num_pages} pages cannot cover watermark_pages="
+                f"{sched.watermark_pages} plus the reserved garbage page",
+            ))
+        seq_pages = -(-sched.max_seq_len // cache.page_size)
+        if cache.num_pages - 1 < seq_pages:
+            issues.append(_err(
+                "cache.num_pages",
+                f"a single max_seq_len={sched.max_seq_len} sequence needs "
+                f"{seq_pages} pages but the pool has {cache.num_pages - 1}",
+            ))
+    if sched.max_seq_len % cache.page_size != 0:
+        issues.append(_warn(
+            "scheduler.max_seq_len",
+            f"not a multiple of page_size={cache.page_size}; the tail page "
+            f"of a full sequence is padded",
+        ))
+    if sched.decode_horizon > 1 and sched.decode_horizon > sched.max_seq_len:
+        issues.append(_err(
+            "scheduler.decode_horizon",
+            f"horizon {sched.decode_horizon} exceeds max_seq_len",
+        ))
+    if cache.dtype not in ("bfloat16", "float32", "float16"):
+        issues.append(_err("cache.dtype", f"unsupported KV dtype {cache.dtype!r}"))
+
+    # ---- dtype coherence
+    if cfg.dtype == "bfloat16" and cache.dtype == "float32":
+        issues.append(_warn(
+            "cache.dtype",
+            "float32 KV with bfloat16 compute doubles KV bandwidth for no "
+            "accuracy gain on TPU",
+        ))
+    return issues
+
+
+def validate_gateway_config(
+    policy: str | None = None,
+    workers: list[str] | None = None,
+    prefill_workers: list[str] | None = None,
+    decode_workers: list[str] | None = None,
+    max_concurrent_requests: int | None = None,
+    kv_connector: str | None = None,
+    mesh_port: int | None = None,
+) -> list[ValidationIssue]:
+    """Validate gateway/launch arguments (reference: validate_mode +
+    validate_policy + validate_server_settings + validate_compatibility)."""
+    from smg_tpu.policies.base import _POLICIES
+
+    issues: list[ValidationIssue] = []
+    if policy is not None and policy not in _POLICIES:
+        issues.append(_err(
+            "policy", f"unknown policy {policy!r}; known: {sorted(_POLICIES)}"
+        ))
+    # PD mode needs BOTH legs (validate_mode: PrefillDecode requires both)
+    pd_p = bool(prefill_workers)
+    pd_d = bool(decode_workers)
+    if pd_p != pd_d:
+        missing = "decode" if pd_p else "prefill"
+        issues.append(_err(
+            "prefill_workers/decode_workers",
+            f"PD disaggregation requires both roles; no {missing} workers given",
+        ))
+    if pd_p and pd_d and workers:
+        issues.append(_warn(
+            "workers",
+            "regular workers are ignored for models that have PD pools",
+        ))
+    for url in (workers or []) + (prefill_workers or []) + (decode_workers or []):
+        if not url or url.isspace():
+            issues.append(_err("workers", "empty worker URL"))
+        elif "://" in url and not url.startswith(("http://", "https://")):
+            issues.append(_err(
+                "workers",
+                f"unsupported scheme in {url!r} (http(s):// = OpenAI-wire "
+                f"proxy, bare host:port = gRPC)",
+            ))
+    if max_concurrent_requests is not None and max_concurrent_requests < 1:
+        issues.append(_err(
+            "max_concurrent_requests", "must be >= 1"
+        ))
+    if kv_connector is not None and kv_connector not in ("auto", "host", "device"):
+        issues.append(_err(
+            "kv_connector", f"unknown connector {kv_connector!r}"
+        ))
+    if mesh_port is not None and not (0 < mesh_port < 65536):
+        issues.append(_err("mesh_port", f"port {mesh_port} out of range"))
+    return issues
+
+
+def raise_on_errors(issues: list[ValidationIssue], logger=None) -> None:
+    """Log warnings; raise ConfigError if any error-severity issues exist."""
+    errors = [i for i in issues if i.severity == "error"]
+    if logger is not None:
+        for i in issues:
+            if i.severity == "warn":
+                logger.warning("config: %s", i)
+    if errors:
+        raise ConfigError(errors)

@@ -57,6 +57,10 @@ class GenerationResult:
 
 class Engine:
     def __init__(self, config: EngineConfig, tokenizer=None, params=None, devices=None):
+        from smg_tpu.config import validate_engine_config
+        from smg_tpu.config.validation import raise_on_errors
+
+        raise_on_errors(validate_engine_config(config), logger=logger)
         self.config = config
         self.tokenizer = tokenizer
         self.events = KvEventPublisher()

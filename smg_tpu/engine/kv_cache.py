@@ -85,21 +85,27 @@ def plan_cache(
     tp: int = 1,
 ) -> KvCacheSpec:
     """Decide num_pages.  With ``auto_size`` and a known HBM budget, fill the
-    headroom left after weights; otherwise use the configured num_pages."""
-    num_pages = cache.num_pages
+    headroom left after weights; otherwise use the configured num_pages.
+
+    The spec always describes the GLOBAL buffer shape (the fused kv-lane dim
+    carries all kv heads; GSPMD shards it over ``tp``).  Sizing inputs are
+    PER-DEVICE: ``hbm_bytes_free`` and ``param_bytes`` are for the tightest
+    single device, and ``tp`` is the kv-lane shard factor, so each device
+    holds ``bytes_per_page / tp`` of every page."""
     spec = KvCacheSpec(
         num_layers=model.num_layers,
-        num_pages=num_pages,
+        num_pages=cache.num_pages,
         page_size=cache.page_size,
-        num_kv_heads=max(model.num_kv_heads // tp, 1),
+        num_kv_heads=model.num_kv_heads,
         head_dim=model.head_dim,
         dtype=cache.dtype,
     )
     if cache.auto_size and hbm_bytes_free is not None:
+        kv_lanes = model.num_kv_heads * model.head_dim
+        kv_shard = tp if tp > 1 and kv_lanes % tp == 0 else 1
+        per_page_device = spec.bytes_per_page // kv_shard
         budget = int(hbm_bytes_free * cache.hbm_utilization) - param_bytes
-        per_page = spec.bytes_per_page
-        auto_pages = max(budget // per_page, 16)
-        spec.num_pages = int(auto_pages)
+        spec.num_pages = int(max(budget // per_page_device, 16))
     return spec
 
 

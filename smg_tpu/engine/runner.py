@@ -104,11 +104,15 @@ class ModelRunner:
             if self._device is not None:
                 self.params = jax.device_put(self.params, self._device)
 
-        # KV cache sizing + buffers
-        param_bytes = sum(x.nbytes for x in jax.tree.leaves(self.params))
+        # KV cache sizing + buffers.  Sizing inputs are per-device: the
+        # tightest device's free HBM and its local parameter shard bytes
+        # (GSPMD shards most weights over tp/ep, so global nbytes would
+        # over-subtract and under-size the cache).
+        param_bytes = self._local_param_bytes()
         hbm_free = self._detect_hbm()
         self.spec: KvCacheSpec = plan_cache(
-            self.model_cfg, config.cache, hbm_free, param_bytes, tp=1
+            self.model_cfg, config.cache, hbm_free, param_bytes,
+            tp=config.parallel.tp,
         )
         # bound pages so the fallback gather in tests stays small
         kv_sharding = None
@@ -151,6 +155,10 @@ class ModelRunner:
         self._lora_rank = 0
 
     def _resolve_attn_impl(self) -> str:
+        """Resolve the configured mode against device capability.  Returns
+        "xla", "pallas", or "auto" (= capable; per-shape choice at trace
+        time in ``_attn_impl_for`` — decode page tables are trimmed per
+        batch, so the gather size is a call property, not an engine one)."""
         import os
 
         cfgd = self.config.attention_impl
@@ -169,22 +177,48 @@ class ModelRunner:
                 return "xla"
         except Exception:
             return "xla"
-        # short contexts: XLA's fused gather+softmax wins (the fused-lane
-        # layout makes the gather relayout-free); long contexts: the gather
-        # materializes B*max_seq_len*KD bytes per layer and the page-streaming
-        # pallas kernel wins.  Crossover measured at ~100k gathered tokens
-        # (1B model, v5e).
-        gathered_tokens = self.config.scheduler.max_batch_size * self.config.scheduler.max_seq_len
-        return "pallas" if gathered_tokens > 131072 else "xla"
+        return "auto"
+
+    def _attn_impl_for(self, B: int, mp: int) -> str:
+        """Per-shape kernel choice.  Short contexts: XLA's fused
+        gather+softmax wins (fused-lane layout makes the gather
+        relayout-free); long contexts: the gather materializes B*mp*ps*KD
+        bytes per layer and the page-streaming pallas kernel wins.
+        Crossover measured at ~100k gathered tokens (1B model, v5e)."""
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        return "pallas" if B * mp * self.spec.page_size > 131072 else "xla"
+
+    def _local_param_bytes(self) -> int:
+        """Bytes of parameters resident on ONE device (the sizing unit)."""
+        leaves = jax.tree.leaves(self.params)
+        if self.mesh is not None:
+            try:
+                return sum(x.addressable_shards[0].data.nbytes for x in leaves)
+            except Exception:
+                return sum(x.nbytes for x in leaves) // self.config.parallel.world_size
+        return sum(x.nbytes for x in leaves)
 
     def _detect_hbm(self) -> int | None:
-        try:
-            stats = jax.devices()[0].memory_stats()
-            if stats and "bytes_limit" in stats:
-                return stats["bytes_limit"] - stats.get("bytes_in_use", 0)
-        except Exception:
-            pass
-        return None
+        """Free HBM on the tightest device this engine will occupy.
+
+        Non-addressable devices (other hosts' chips on a multi-host mesh) and
+        backends without memory stats are skipped; None only when NO device
+        reports stats (auto-size then falls back to configured num_pages)."""
+        devs = list(self.mesh.devices.flat) if self.mesh is not None else (
+            [self._device] if self._device is not None else jax.devices()[:1]
+        )
+        free = None
+        for d in devs:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                continue
+            if not stats or "bytes_limit" not in stats:
+                continue
+            f = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+            free = f if free is None else min(free, f)
+        return free
 
     # ---- penalty slot state ----
 
@@ -288,13 +322,15 @@ class ModelRunner:
         return jax.random.fold_in(self._rng_key, self._step)
 
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
-                    use_mask: bool = False, use_lora: bool = False):
-        k = ("prefill", T, mp, use_pen, use_mask, use_lora)
+                    use_mask: bool = False, use_lora: bool = False,
+                    use_ring: bool = False):
+        k = ("prefill", T, mp, use_pen, use_mask, use_lora, use_ring)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
         n_slots = self.lora_slots
+        sp_mesh = self.mesh if use_ring else None
 
         def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
                  key, temp, topk, topp, minp, *extra):
@@ -312,7 +348,7 @@ class ModelRunner:
                 lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
-                lora=lora_bank, lora_gates=lora_gates,
+                lora=lora_bank, lora_gates=lora_gates, sp_mesh=sp_mesh,
             )
             logits = logits[None]
             if use_pen:
@@ -489,7 +525,7 @@ class ModelRunner:
         ps = self.spec.page_size
         KD = cfg.num_kv_heads * cfg.head_dim
         L = cfg.num_layers
-        attn_impl = self.attn_impl
+        attn_impl = self._attn_impl_for(B, mp)
 
         n_slots = self.lora_slots
 
@@ -679,8 +715,16 @@ class ModelRunner:
         tokens[:t] = token_ids
         mp = len(page_table)
         use_lora = lora_idx > 0 and self._lora_bank is not None
+        # sequence-parallel prefill: cold chunks (the long-context case — a
+        # huge first chunk is exactly what sp exists for) ring-attend with the
+        # token dim sharded over sp; warm chunks need the cache gather
+        sp = self.config.parallel.sp
+        use_ring = (
+            self.mesh is not None and sp > 1 and prefix_len == 0 and T % sp == 0
+        )
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
-                              use_mask=mask is not None, use_lora=use_lora)
+                              use_mask=mask is not None, use_lora=use_lora,
+                              use_ring=use_ring)
         args = [
             self.params,
             self.inv_freq,

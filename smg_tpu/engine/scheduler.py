@@ -88,12 +88,21 @@ class Scheduler:
 
     def loads(self) -> dict:
         running = sum(1 for s in self.slots if s is not None)
+        # token-load estimate for dp-aware routing: un-prefilled prompt tokens
+        # plus the remaining generation budget of every admitted request
+        queued = sum(
+            len(r.prompt_ids) + r.sampling.max_new_tokens for r in self.waiting
+        )
+        for s in self.slots:
+            if s is not None:
+                queued += max(s.sampling.max_new_tokens - len(s.output_ids), 0)
         return {
             "num_waiting": len(self.waiting),
             "num_running": running,
             "free_pages": self.pool.free_count,
             "cached_pages": self.radix.num_cached_pages if self.radix else 0,
             "total_pages": self.runner.spec.num_pages,
+            "queued_tokens": queued,
         }
 
     def flush_cache(self) -> bool:
@@ -320,9 +329,22 @@ class Scheduler:
         B = self.sched.decode_bucket(B_real)
         V = self.runner.model_cfg.vocab_size
         S = self.sched.max_batch_size  # runner's garbage penalty-state row
+        # Trim the page table to the pages LIVE this horizon (bucketed so jit
+        # variants stay bounded): the XLA decode attention gathers
+        # B*mp*page_size tokens of KV per layer, so rows sized to max_seq_len
+        # make every decode pay for the worst-case context.  A batch at mean
+        # context 256 of max 8192 reads 32x less with trimmed rows.
+        pages_needed = max(
+            math.ceil(min(r.seq_len + horizon, self.sched.max_seq_len) / self.ps)
+            for _, r in active
+        )
+        mp_b = 8
+        while mp_b < pages_needed:
+            mp_b *= 2
+        mp_b = min(mp_b, self.mp)
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
-        page_tables = np.zeros((B, self.mp), np.int32)
+        page_tables = np.zeros((B, mp_b), np.int32)
         temps = np.zeros(B, np.float32)
         topks = np.full(B, -1, np.int32)
         topps = np.ones(B, np.float32)
@@ -336,7 +358,7 @@ class Scheduler:
         for idx, (slot, req) in enumerate(active):
             tokens[idx] = req.output_ids[-1]
             positions[idx] = req.seq_len
-            page_tables[idx] = self.page_tables[slot]
+            page_tables[idx] = self.page_tables[slot][:mp_b]
             sp = req.sampling
             temps[idx] = sp.temperature
             topks[idx] = sp.top_k
@@ -357,9 +379,9 @@ class Scheduler:
                 mask_arr[idx] = self._mask_for(req)
             if use_lora:
                 lora_idx[idx] = req.lora_idx
-        # padded rows: positions land beyond mp*ps so writes hit the garbage page
+        # padded rows: positions land beyond mp_b*ps so writes hit the garbage page
         for idx in range(B_real, B):
-            positions[idx] = self.mp * self.ps
+            positions[idx] = mp_b * self.ps
 
         toks, lps = self.runner.decode_multi(
             tokens, positions, page_tables, temps, topks, topps, minps, horizon,

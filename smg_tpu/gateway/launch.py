@@ -157,7 +157,12 @@ async def _run_gateway(args) -> int:
         (reference: worker_startup_timeout_secs)."""
         from smg_tpu.rpc.client import GrpcWorkerClient
 
-        client = GrpcWorkerClient(url)
+        if url.startswith(("http://", "https://")):
+            from smg_tpu.gateway.http_worker import HttpWorkerClient
+
+            client = HttpWorkerClient(url)
+        else:
+            client = GrpcWorkerClient(url)
         info = None
         while True:
             try:
@@ -176,6 +181,7 @@ async def _run_gateway(args) -> int:
             Worker(
                 worker_id=url, client=client, model_id=model_id,
                 url=url, page_size=info.get("page_size") or None, worker_type=wtype,
+                dp_size=info.get("dp_size") or 1,
             )
         )
         # no tokenizer mirrored onto the gateway host? fetch the worker's

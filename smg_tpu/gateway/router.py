@@ -62,6 +62,10 @@ class RouterConfig:
     # parser selection: None = auto by model name; "passthrough" disables
     reasoning_parser: str | None = None
     tool_parser: str | None = None
+    # DP-rank stage for dp_size>1 workers: "dp_min_token" pins each request to
+    # the replica with the fewest outstanding tokens; "dp_passthrough" lets
+    # the worker balance locally (reference: dp_min_token.rs:24-31)
+    dp_rank_policy: str = "dp_min_token"
 
 
 @dataclass
@@ -90,6 +94,20 @@ class Router:
         self.policies = policies
         self.tokenizers = tokenizers
         self.config = config or RouterConfig()
+        from smg_tpu.policies.dp import MinimumTokensPolicy, PassthroughDpPolicy
+
+        self.dp_policy = (
+            PassthroughDpPolicy()
+            if self.config.dp_rank_policy == "dp_passthrough"
+            else MinimumTokensPolicy()
+        )
+        manager = getattr(self.dp_policy, "manager", None)
+        if manager is not None:
+            registry.on_change(
+                lambda ev, w: manager.on_worker_removed(w.worker_id)
+                if ev == "removed"
+                else None
+            )
 
     # ---- worker selection (stage 2) ----
 
@@ -103,7 +121,10 @@ class Router:
         self, ctx: RequestContext, exclude: set[str] = frozenset()
     ) -> Worker:
         workers = [
-            w for w in self._candidate_workers(ctx.model_id) if w.worker_id not in exclude
+            w for w in self._candidate_workers(ctx.model_id)
+            if w.worker_id not in exclude
+            # text-level proxy workers can't serve the token-level path
+            and not getattr(w.client, "proxy_mode", False)
         ]
         if not workers:
             raise RouteError(503, "no workers available", "service_unavailable")
@@ -112,6 +133,19 @@ class Router:
         if worker is None:
             raise RouteError(503, "no healthy workers available", "service_unavailable")
         return worker
+
+    def select_proxy_worker(self, model_id: str | None, ctx: RequestContext | None = None) -> Worker | None:
+        """Policy-select among HTTP proxy-mode workers for ``model_id``
+        (reference: the HTTP router path, ``routers/http/router.rs``).
+        None when the model has no proxy workers — token-level path applies."""
+        workers = [
+            w for w in self._candidate_workers(model_id)
+            if getattr(w.client, "proxy_mode", False)
+        ]
+        if not workers:
+            return None
+        policy = self.policies.policy_for(model_id)
+        return policy.select_worker(workers, ctx or RequestContext(model_id=model_id))
 
     def _pd_pools(self, model_id: str | None):
         """(prefill_pool, decode_pool) — non-empty pair means PD mode
@@ -154,14 +188,18 @@ class Router:
 
         attempts = 0
         exclude: set[str] = set()
+        # dp-rank cost estimate: prompt + generation budget (released on exit)
+        dp_cost = len(input_ids) + (worker_sampling.max_new_tokens or 0)
         while True:
             worker = self.select_worker(ctx, exclude=exclude)
             guard = worker.acquire()
             got_first_chunk = False
             finished_cleanly = False
+            dp_rank = self.dp_policy.select_dp_rank(worker, dp_cost)
             try:
                 wreq = WorkerGenerateRequest(
-                    rid=rid, input_ids=input_ids, sampling=worker_sampling
+                    rid=rid, input_ids=input_ids, sampling=worker_sampling,
+                    data_parallel_rank=-1 if dp_rank is None else dp_rank,
                 )
                 async for chunk in worker.client.generate(wreq):
                     got_first_chunk = True
@@ -209,6 +247,8 @@ class Router:
                 )
                 await asyncio.sleep(backoff)
             finally:
+                if dp_rank is not None:
+                    self.dp_policy.release(worker, dp_rank, dp_cost)
                 if not finished_cleanly:
                     guard.release(success=True)  # no-op if already released
 

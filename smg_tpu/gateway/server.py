@@ -76,7 +76,10 @@ class AppContext:
         self.priority = PriorityScheduler(
             priority_config or PriorityConfig(slots=max_concurrent_requests)
         )
-        self.health_monitor = HealthMonitor(self.registry, health_config, self.metrics)
+        self.health_monitor = HealthMonitor(
+            self.registry, health_config, self.metrics,
+            dp_loads=getattr(self.router.dp_policy, "manager", None),
+        )
         from smg_tpu.gateway.responses import ResponsesHandler
         from smg_tpu.mcp import McpRegistry
         from smg_tpu.storage import MemoryStorage
@@ -140,6 +143,46 @@ async def error_middleware(request: web.Request, handler):
     except Exception as e:
         logger.exception("unhandled error on %s", request.path)
         return _error(500, f"internal error: {e}", "internal_error")
+
+
+@web.middleware
+async def plugin_middleware(request: web.Request, handler):
+    """Plugin middleware hooks (reference: the WASM component host,
+    ``crates/wasm/src/interface/spec.wit`` — on-request/on-response with
+    continue/reject/modify actions).  No-op unless plugins are loaded."""
+    ctx: AppContext = request.app["ctx"]
+    host = ctx.plugins
+    if host is None or not host.plugins:
+        return await handler(request)
+    from smg_tpu.plugins import PluginResponse, Reject
+
+    preq = host.make_request(request, request.get("request_id", ""))
+    action = await host.on_request(preq)
+    if isinstance(action, Reject):
+        return _error(action.status, action.message or "rejected by plugin",
+                      "plugin_rejected")
+    # header modifications visible to downstream handlers
+    request["plugin_headers"] = preq.headers
+    resp = await handler(request)
+    if isinstance(resp, web.Response) and resp.body is not None:
+        presp = PluginResponse(
+            status=resp.status,
+            headers={k.lower(): v for k, v in resp.headers.items()},
+            body=bytes(resp.body) if resp.body else b"",
+        )
+        action = await host.on_response(presp)
+        if isinstance(action, Reject):
+            return _error(action.status, action.message or "rejected by plugin",
+                          "plugin_rejected")
+        if presp.status != resp.status or presp.body != (resp.body or b""):
+            return web.Response(
+                status=presp.status, body=presp.body,
+                content_type=resp.content_type,
+            )
+        for k, v in presp.headers.items():
+            if k not in ("content-type", "content-length"):
+                resp.headers[k] = v
+    return resp
 
 
 @web.middleware
@@ -245,7 +288,8 @@ async def _run_preemptable(ctx, request, handler, guard, priority: str):
 def build_app(ctx: AppContext) -> web.Application:
     app = web.Application(
         middlewares=[
-            request_id_middleware, error_middleware, auth_middleware, admission_middleware,
+            request_id_middleware, error_middleware, plugin_middleware,
+            auth_middleware, admission_middleware,
         ]
     )
     app["ctx"] = ctx
@@ -393,6 +437,11 @@ async def h_chat(request: web.Request) -> web.Response | web.StreamResponse:
     adapter = ctx.providers.resolve(req.model)
     if adapter is not None:
         return await _chat_via_provider(request, ctx, adapter, req)
+    proxy_worker = ctx.router.select_proxy_worker(req.model)
+    if proxy_worker is not None:
+        return await _proxy_via_http_worker(
+            request, ctx, proxy_worker, req, "/v1/chat/completions"
+        )
     async with ctx.semaphore:
         if not req.stream:
             resp = await ctx.router.chat(req, request_id=rid)
@@ -442,6 +491,46 @@ async def _chat_via_provider(request, ctx, adapter, req) -> web.Response | web.S
         return sse
 
 
+async def _proxy_via_http_worker(
+    request, ctx, worker, req, path: str
+) -> web.Response | web.StreamResponse:
+    """HTTP engine-worker proxy path (reference: ``routers/http/router.rs``):
+    text-level passthrough to an OpenAI-compatible worker, with registry
+    citizenship — load guard, circuit breaker feedback, worker metrics."""
+    from smg_tpu.gateway.http_worker import HttpWorkerError
+
+    body = req.model_dump(exclude_none=True, exclude_unset=True)
+    async with ctx.semaphore:
+        guard = worker.acquire()
+        ok = False
+        try:
+            if not req.stream:
+                try:
+                    data = await worker.client.post_json(path, body)
+                except HttpWorkerError as e:
+                    return _error(502 if e.status >= 500 else e.status,
+                                  f"worker error: {e.message}", "worker_error")
+                except Exception as e:
+                    return _error(502, f"worker unreachable: {e}", "worker_error")
+                ok = True
+                return web.json_response(data)
+            sse = _sse_response(request)
+            await sse.prepare(request)
+            try:
+                async for chunk in worker.client.stream_sse(path, body):
+                    await sse.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                await sse.write(b"data: [DONE]\n\n")
+                ok = True
+            except (HttpWorkerError, Exception) as e:
+                msg = getattr(e, "message", str(e))
+                err = ErrorResponse(error=ErrorInfo(message=msg, type="worker_error"))
+                await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
+            await sse.write_eof()
+            return sse
+        finally:
+            guard.release(success=ok)
+
+
 async def h_completions(request: web.Request) -> web.Response | web.StreamResponse:
     ctx: AppContext = request.app["ctx"]
     try:
@@ -449,6 +538,11 @@ async def h_completions(request: web.Request) -> web.Response | web.StreamRespon
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
+    proxy_worker = ctx.router.select_proxy_worker(req.model)
+    if proxy_worker is not None:
+        return await _proxy_via_http_worker(
+            request, ctx, proxy_worker, req, "/v1/completions"
+        )
     async with ctx.semaphore:
         if not req.stream:
             resp = await ctx.router.completion(req, request_id=rid)
@@ -946,9 +1040,16 @@ async def h_workers_add(request: web.Request) -> web.Response:
     url = body.get("url")
     if not url:
         return _error(400, "missing url")
-    from smg_tpu.rpc.client import GrpcWorkerClient
+    # transport by scheme: http(s):// = OpenAI-wire proxy worker
+    # (routers/http/router.rs path); bare host:port = token-level gRPC
+    if url.startswith(("http://", "https://")):
+        from smg_tpu.gateway.http_worker import HttpWorkerClient
 
-    client = GrpcWorkerClient(url)
+        client = HttpWorkerClient(url, api_key=body.get("api_key", ""))
+    else:
+        from smg_tpu.rpc.client import GrpcWorkerClient
+
+        client = GrpcWorkerClient(url)
     try:
         info = await client.get_model_info()
     except Exception as e:
@@ -960,6 +1061,7 @@ async def h_workers_add(request: web.Request) -> web.Response:
         model_id=body.get("model_id") or info.get("model_id", "default"),
         url=url,
         page_size=info.get("page_size") or None,
+        dp_size=info.get("dp_size") or 1,
     )
     ctx.registry.add(worker)
     return web.json_response({"added": worker.describe()})

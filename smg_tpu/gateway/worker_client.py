@@ -24,6 +24,9 @@ class WorkerGenerateRequest:
     input_ids: list[int]
     sampling: SamplingParams
     stream: bool = True
+    # external DP dispatch: pin to one of the worker's engine replicas
+    # (-1 = worker chooses; reference sglang_scheduler.proto:157-158)
+    data_parallel_rank: int = -1
 
 
 @dataclass

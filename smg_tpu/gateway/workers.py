@@ -101,6 +101,7 @@ class Worker:
         url: str = "",
         priority: int = 0,
         page_size: int | None = None,
+        dp_size: int = 1,
     ):
         self.worker_id = worker_id
         self.client = client
@@ -109,6 +110,7 @@ class Worker:
         self.url = url or worker_id
         self.priority = priority
         self.page_size = page_size  # engine KV page size (cache_aware event mode)
+        self.dp_size = max(int(dp_size), 1)  # DP engine replicas behind this worker
         self.circuit = CircuitBreaker()
         self.healthy = True
         self.draining = False  # drain-before-remove: no new selections

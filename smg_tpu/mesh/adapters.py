@@ -123,6 +123,15 @@ class TreeSyncAdapter:
 
         self._published: OrderedDict[str, None] = OrderedDict()
         self._max_entries = max_entries
+        # origin tag baked into every key this gateway publishes: two
+        # gateways caching the same prefix publish under DIFFERENT keys, so
+        # a local LRU eviction's replicated tombstone can only ever remove
+        # our own entries, never a peer's still-valid one
+        import hashlib as _hl
+
+        self._origin = _hl.blake2b(
+            str(getattr(state, "node_id", "")).encode(), digest_size=4
+        ).hexdigest()
         state.on_change(self._on_state_change)
         policies.add_create_hook(self._on_policy_created)
 
@@ -156,8 +165,9 @@ class TreeSyncAdapter:
             repr(payload).encode(), digest_size=12
         ).hexdigest()
         # LwwMap.set notifies local listeners synchronously: the flag stops
-        # the publish from echoing back into apply on the routing hot path
-        key = f"{TREE_NS}{model}/{digest}"
+        # the publish from echoing back into apply on the routing hot path.
+        # Key carries the origin tag (see __init__) so evictions are local.
+        key = f"{TREE_NS}{model}/{digest}.{self._origin}"
         self._publishing = True
         try:
             self.state.set(

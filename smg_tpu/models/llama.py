@@ -205,8 +205,16 @@ def forward_prefill(
     page_table: jnp.ndarray,  # [mp] pages owned by this sequence
     lora: Params | None = None,  # stacked [L, N, ...] adapter bank
     lora_gates: jnp.ndarray | None = None,  # [N] one-hot (one sequence)
+    sp_mesh=None,  # Mesh: sequence-parallel ring attention over the "sp" axis
 ):
-    """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache)."""
+    """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache).
+
+    ``sp_mesh`` (long-context serving, SURVEY.md §7.5 "sequence-parallel
+    prefill"): the chunk's attention runs as blockwise ring attention with the
+    token dim sharded over the ``sp`` mesh axis — KV shards rotate via
+    ppermute over ICI instead of every device holding the full chunk.  Only
+    valid for COLD chunks (prefix_len==0: the chunk is the entire context);
+    chunks extending a cached prefix use the dense gather path."""
     T = tokens.shape[0]
     if lora is not None:
         lora_gates = jnp.broadcast_to(lora_gates, (T, lora_gates.shape[-1]))
@@ -235,8 +243,15 @@ def forward_prefill(
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
         k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
-        k_ctx, v_ctx = gather_seq_kv(k_cache[l], v_cache[l], page_table, cfg.num_kv_heads)
-        attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
+        if sp_mesh is not None:
+            from smg_tpu.parallel.ring_attention import ring_attention
+
+            attn = ring_attention(q[None], k[None], v[None], sp_mesh, scale)[0]
+        else:
+            k_ctx, v_ctx = gather_seq_kv(
+                k_cache[l], v_cache[l], page_table, cfg.num_kv_heads
+            )
+            attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
         h = h + _attn_out(layer, attn, lor, lora_gates)
         hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(layer, hn, cfg)
@@ -535,6 +550,8 @@ def forward_train(
     inv_freq: jnp.ndarray,
     tokens: jnp.ndarray,  # [B, T]
     ring_mesh=None,  # Mesh with an "sp" axis: use ring attention (seq parallel)
+    pp_mesh=None,  # Mesh with a "pp" axis: microbatch pipeline over stages
+    num_microbatches: int = 1,
 ) -> jnp.ndarray:
     """Dense causal forward for training / eval-logprobs: logits [B, T, V].
 
@@ -542,36 +559,67 @@ def forward_train(
     attention over the ``sp`` axis (``smg_tpu/parallel/ring_attention.py``) —
     KV shards rotate over ICI instead of the all-gather GSPMD would insert,
     which is what makes million-token-class sequence parallelism viable.
+    With ``pp_mesh`` the layer stack runs as a microbatch pipeline over the
+    ``pp`` axis (``smg_tpu/parallel/pipeline.py``); embed and unembed stay
+    under GSPMD outside the pipeline region.
     """
-    B, T = tokens.shape
-    scale = 1.0 / math.sqrt(cfg.head_dim)
-    pos = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = embed_tokens(params, cfg, tokens)
 
-    causal = jnp.tril(jnp.ones((T, T), bool))
+    if pp_mesh is not None and pp_mesh.shape.get("pp", 1) > 1:
+        from smg_tpu.parallel.pipeline import pipeline_apply
 
-    def layer_body(h, layer):
-        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn)  # [B, T, H/K, D]
-        q = apply_rope(q, pos, inv_freq)
-        k = apply_rope(k, pos, inv_freq)
-        K = cfg.num_kv_heads
-        G = cfg.num_heads // K
-        if ring_mesh is not None:
-            from smg_tpu.parallel.ring_attention import ring_attention
+        h = pipeline_apply(
+            lambda layer, x: decoder_layer_train(
+                layer, x, cfg, inv_freq, ring_mesh=ring_mesh
+            ),
+            params["layers"],
+            h,
+            pp_mesh,
+            num_microbatches=num_microbatches,
+        )
+    else:
+        def layer_body(h, layer):
+            return (
+                decoder_layer_train(layer, h, cfg, inv_freq, ring_mesh=ring_mesh),
+                None,
+            )
 
-            attn = ring_attention(q, k, v, ring_mesh, scale)
-        else:
-            qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
-            scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
-            scores = jnp.where(causal[None, None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
-            attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
-        h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
-        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn, cfg)
-        return h, None
-
-    h, _ = jax.lax.scan(layer_body, h, params["layers"])
+        h, _ = jax.lax.scan(layer_body, h, params["layers"])
     return unembed(params, cfg, h)
+
+
+def decoder_layer_train(
+    layer: Params,
+    h: jnp.ndarray,  # [B, T, E]
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    ring_mesh=None,
+) -> jnp.ndarray:
+    """One decoder layer, dense causal (training/eval) — shared by the
+    ``forward_train`` layer scan and the pipeline-parallel schedule
+    (``smg_tpu/parallel/pipeline.py``), which scans it over a pp stage's
+    local layer shard."""
+    B, T = h.shape[0], h.shape[1]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    pos = jnp.arange(T)[None, :].repeat(B, axis=0)
+    hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(layer, cfg, hn)  # [B, T, H/K, D]
+    q = apply_rope(q, pos, inv_freq)
+    k = apply_rope(k, pos, inv_freq)
+    K = cfg.num_kv_heads
+    G = cfg.num_heads // K
+    if ring_mesh is not None:
+        from smg_tpu.parallel.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, ring_mesh, scale)
+    else:
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
+        scores = jnp.where(causal[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+        attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
+    h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
+    hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+    return h + _mlp(layer, hn, cfg)

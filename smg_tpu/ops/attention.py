@@ -149,18 +149,22 @@ def attention_decode_cached(
     K = KD // D
     N = hk.shape[1]
     G = H // K
+    # Stay in the cache dtype through the matmuls (f32 ACCUMULATION via
+    # preferred_element_type): converting the gather to f32 doubles its HBM
+    # write traffic, and decode is bandwidth-bound.
+    cd = k_cache.dtype
     kl = k_cache[layer][page_tables]  # [B, mp, ps, KD]
     vl = v_cache[layer][page_tables]
     mp = kl.shape[1]
     S = mp * ps
-    kl = kl.reshape(B, S, K, D).astype(jnp.float32)
-    vl = vl.reshape(B, S, K, D).astype(jnp.float32)
-    hk4 = hk.reshape(B, N, K, D).astype(jnp.float32)
-    hv4 = hv.reshape(B, N, K, D).astype(jnp.float32)
-    k_all = jnp.concatenate([kl, hk4], axis=1)  # [B, S+N, K, D]
-    v_all = jnp.concatenate([vl, hv4], axis=1)
-    qf = q.astype(jnp.float32).reshape(B, K, G, D)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qf, k_all) * scale
+    kl = kl.reshape(B, S, K, D)
+    vl = vl.reshape(B, S, K, D)
+    k_all = jnp.concatenate([kl, hk.reshape(B, N, K, D).astype(cd)], axis=1)
+    v_all = jnp.concatenate([vl, hv.reshape(B, N, K, D).astype(cd)], axis=1)
+    qf = q.astype(cd).reshape(B, K, G, D)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qf, k_all, preferred_element_type=jnp.float32
+    ) * scale
     j = jnp.arange(S + N)
     mask = jnp.where(
         j[None, :] < S,
@@ -169,7 +173,10 @@ def attention_decode_cached(
     )
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_all)
+    out = jnp.einsum(
+        "bkgs,bskd->bkgd", probs.astype(cd), v_all,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, H, D).astype(q.dtype)
 
 
@@ -190,18 +197,24 @@ def attention_decode(
     B, H, D = q.shape
     P, ps, KD = k_pages.shape
     K = KD // D
+    cd = k_pages.dtype  # cache-dtype matmuls, f32 accumulation (HBM-bound op)
     k = k_pages[page_tables]  # [B, mp, ps, KD]
     v = v_pages[page_tables]
     mp = k.shape[1]
     S = mp * ps
-    k = k.reshape(B, S, K, D).astype(jnp.float32)
-    v = v.reshape(B, S, K, D).astype(jnp.float32)
+    k = k.reshape(B, S, K, D)
+    v = v.reshape(B, S, K, D)
     G = H // K
-    qf = q.astype(jnp.float32).reshape(B, K, G, D)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qf, k) * scale
+    qf = q.astype(cd).reshape(B, K, G, D)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qf, k, preferred_element_type=jnp.float32
+    ) * scale
     j = jnp.arange(S)
     mask = j[None, :] <= positions[:, None]  # [B, S]
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    out = jnp.einsum(
+        "bkgs,bskd->bkgd", probs.astype(cd), v,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, H, D).astype(q.dtype)

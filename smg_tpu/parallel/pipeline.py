@@ -1,0 +1,127 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference reserves pipeline parallelism to its external engines
+(SURVEY.md §2.5); here it is a first-class TPU schedule.  Design (the
+jax-idiomatic microbatch pipeline, NOT a port of torch-style stage
+processes):
+
+- The stacked per-layer parameters ([L, ...] leading axis) are sharded over
+  ``pp``: stage ``s`` holds layers ``[s*L/S, (s+1)*L/S)``.
+- ``jax.shard_map`` runs MANUAL over the ``pp`` axis only (``axis_names=
+  {"pp"}``): every other mesh axis (tp/dp/sp/ep) stays under GSPMD inside the
+  stage body, so tensor-parallel einsums keep their automatic collectives —
+  no hand-written TP all-reduces in the stage.
+- The batch splits into M microbatches that flow through the S stages over
+  ``M + S - 1`` ticks of a ``lax.scan``; activations hop stage-to-stage via
+  ``lax.ppermute`` (neighbor ICI/DCN links — pp is the outermost mesh axis,
+  ``smg_tpu/parallel/mesh.py``).  Pipeline bubble: (S-1)/(M+S-1) of ticks.
+- Every device runs the same program (SPMD): stage identity comes from
+  ``lax.axis_index``; idle ticks compute on zero microbatches (the usual
+  XLA static-shape trade).
+- The last stage's outputs are broadcast back with a ``psum`` (all other
+  stages contribute zeros), so downstream unembed/loss runs replicated over
+  pp under GSPMD.
+
+Autodiff flows through scan + ppermute + psum, so ``jax.grad`` of a
+pipelined forward gives the standard 1F1B-equivalent-memory backward that
+XLA schedules (no manual backward schedule needed at these depths).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(
+    layer_fn,
+    stacked_layers,
+    h: jnp.ndarray,  # [B, T, E] activations (post-embed)
+    mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+) -> jnp.ndarray:
+    """Run ``h`` through all L layers with the layer stack sharded over
+    ``axis``.  ``layer_fn(layer_params, x) -> x`` is one decoder layer;
+    ``stacked_layers`` is a pytree whose leaves have the layer dim leading.
+
+    Requires L %% S == 0 and B %% num_microbatches == 0.
+    """
+    S = mesh.shape[axis]
+    if S <= 1:
+        def scan_all(x):
+            def body(c, layer):
+                return layer_fn(layer, c), None
+            y, _ = jax.lax.scan(body, x, stacked_layers)
+            return y
+        return scan_all(h)
+
+    B = h.shape[0]
+    M = num_microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    L = jax.tree.leaves(stacked_layers)[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"num_layers {L} not divisible by pp={S}")
+    mb = B // M
+
+    def body(layers_local, h_full):
+        idx = jax.lax.axis_index(axis)
+        T, E = h_full.shape[1], h_full.shape[2]
+        hm = h_full.reshape(M, mb, T, E)
+
+        def stage(x):
+            def lb(c, layer):
+                return layer_fn(layer, c), None
+            y, _ = jax.lax.scan(lb, x, layers_local)
+            return y
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            recv, outs = carry
+            inject = jnp.where(
+                t < M,
+                jax.lax.dynamic_index_in_dim(
+                    hm, jnp.clip(t, 0, M - 1), keepdims=False
+                ),
+                jnp.zeros((mb, T, E), h_full.dtype),
+            )
+            x = jnp.where(idx == 0, inject, recv)
+            y = stage(x)
+            recv_next = jax.lax.ppermute(y, axis, perm)
+            oidx = t - (S - 1)
+            contrib = jnp.where(
+                (idx == S - 1) & (oidx >= 0), y, jnp.zeros_like(y)
+            )
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jax.lax.dynamic_index_in_dim(
+                    outs, jnp.clip(oidx, 0, M - 1), keepdims=False
+                )
+                + contrib,
+                jnp.clip(oidx, 0, M - 1),
+                axis=0,
+            )
+            return (recv_next, outs), None
+
+        outs0 = jnp.zeros((M, mb, T, E), h_full.dtype)
+        recv0 = jnp.zeros((mb, T, E), h_full.dtype)
+        (_, outs), _ = jax.lax.scan(
+            tick, (recv0, outs0), jnp.arange(M + S - 1)
+        )
+        # only the last stage holds real outputs; psum replicates them
+        outs = jax.lax.psum(outs, axis)
+        return outs.reshape(B, T, E)
+
+    layer_specs = jax.tree.map(lambda _: P(axis), stacked_layers)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )
+    return fn(stacked_layers, h)

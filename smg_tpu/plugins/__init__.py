@@ -1,0 +1,19 @@
+from smg_tpu.plugins.spec import (
+    Action,
+    Continue,
+    Modify,
+    PluginRequest,
+    PluginResponse,
+    Reject,
+)
+from smg_tpu.plugins.host import PluginHost
+
+__all__ = [
+    "Action",
+    "Continue",
+    "Modify",
+    "PluginHost",
+    "PluginRequest",
+    "PluginResponse",
+    "Reject",
+]

@@ -128,7 +128,9 @@ class GrpcWorkerClient(WorkerClient):
 
     async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
         msg = pb.GenerateRequestProto(
-            rid=req.rid, input_ids=req.input_ids, sampling=sampling_to_proto(req.sampling)
+            rid=req.rid, input_ids=req.input_ids,
+            sampling=sampling_to_proto(req.sampling),
+            data_parallel_rank=req.data_parallel_rank,
         )
         call = self._generate(msg)
         try:
@@ -241,6 +243,7 @@ class GrpcWorkerClient(WorkerClient):
             "free_pages": resp.free_pages,
             "cached_pages": resp.cached_pages,
             "total_pages": resp.total_pages,
+            "dp_queued_tokens": list(resp.dp_queued_tokens),
         }
 
     async def get_model_info(self) -> dict:
@@ -251,6 +254,7 @@ class GrpcWorkerClient(WorkerClient):
             "vocab_size": resp.vocab_size,
             "eos_token_ids": list(resp.eos_token_ids),
             "page_size": resp.page_size,
+            "dp_size": resp.dp_size or 1,
         }
 
     async def flush_cache(self) -> bool:

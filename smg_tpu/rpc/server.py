@@ -26,8 +26,33 @@ logger = get_logger("rpc.server")
 
 
 class SchedulerServicer:
-    def __init__(self, engine):
-        self.engine = engine
+    """One worker = one or more data-parallel engine replicas.
+
+    With ``engines=[e0, e1, ...]`` the worker serves external DP dispatch
+    (reference: ``data_parallel_rank``, sglang_scheduler.proto:157-158):
+    a pinned ``data_parallel_rank`` routes to that replica; -1 routes to the
+    replica with the fewest queued tokens.  Aux RPCs (tokenizer, LoRA,
+    profile, model info) address replica 0 — replicas are homogeneous."""
+
+    def __init__(self, engine=None, engines: "list | None" = None):
+        if engines is None:
+            engines = [engine]
+        if not engines or any(e is None for e in engines):
+            raise ValueError("need at least one engine")
+        self.engines = list(engines)
+        self.engine = self.engines[0]
+
+    def _engine_for(self, rank: int):
+        """Pick the DP replica for a request; raises on out-of-range pins."""
+        if rank >= len(self.engines):
+            raise ValueError(
+                f"data_parallel_rank {rank} out of range (dp_size {len(self.engines)})"
+            )
+        if rank >= 0:
+            return self.engines[rank]
+        if len(self.engines) == 1:
+            return self.engine
+        return min(self.engines, key=lambda e: e.loads()["queued_tokens"])
 
     async def Generate(self, request: pb.GenerateRequestProto, context):
         loop = asyncio.get_running_loop()
@@ -39,7 +64,8 @@ class SchedulerServicer:
 
         rid = request.rid
         try:
-            self.engine.submit(
+            engine = self._engine_for(request.data_parallel_rank)
+            engine.submit(
                 list(request.input_ids), sampling, rid=rid,
                 on_output=on_output, priority=request.priority,
             )
@@ -72,7 +98,7 @@ class SchedulerServicer:
                     return
         finally:
             # client went away mid-stream: stop generating
-            self.engine.abort(rid)
+            engine.abort(rid)
 
     async def Embed(self, request: pb.EmbedRequestProto, context):
         loop = asyncio.get_running_loop()
@@ -166,19 +192,21 @@ class SchedulerServicer:
             self.engine.abort(rid)
 
     async def Abort(self, request: pb.AbortRequestProto, context):
-        return pb.AbortResponseProto(ok=self.engine.abort(request.rid))
+        ok = any(e.abort(request.rid) for e in self.engines)
+        return pb.AbortResponseProto(ok=ok)
 
     async def HealthCheck(self, request: pb.EmptyProto, context):
         return pb.HealthResponseProto(ok=True)
 
     async def GetLoads(self, request: pb.EmptyProto, context):
-        loads = self.engine.loads()
+        per_rank = [e.loads() for e in self.engines]
         return pb.LoadsProto(
-            num_waiting=loads["num_waiting"],
-            num_running=loads["num_running"],
-            free_pages=loads["free_pages"],
-            cached_pages=loads["cached_pages"],
-            total_pages=loads["total_pages"],
+            num_waiting=sum(l["num_waiting"] for l in per_rank),
+            num_running=sum(l["num_running"] for l in per_rank),
+            free_pages=sum(l["free_pages"] for l in per_rank),
+            cached_pages=sum(l["cached_pages"] for l in per_rank),
+            total_pages=sum(l["total_pages"] for l in per_rank),
+            dp_queued_tokens=[l["queued_tokens"] for l in per_rank],
         )
 
     async def GetModelInfo(self, request: pb.EmptyProto, context):
@@ -189,10 +217,11 @@ class SchedulerServicer:
             vocab_size=cfg.model.vocab_size,
             eos_token_ids=list(cfg.model.eos_token_ids),
             page_size=cfg.cache.page_size,
+            dp_size=len(self.engines),
         )
 
     async def FlushCache(self, request: pb.EmptyProto, context):
-        return pb.FlushResponseProto(ok=self.engine.flush_cache())
+        return pb.FlushResponseProto(ok=all(e.flush_cache() for e in self.engines))
 
     async def LoadLoRAAdapter(self, request: pb.LoadLoraRequestProto, context):
         loop = asyncio.get_running_loop()
@@ -377,14 +406,18 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(SERVICE, rpcs)
 
 
-async def serve_worker_async(engine, port: int, host: str = "0.0.0.0") -> grpc.aio.Server:
+async def serve_worker_async(
+    engine, port: int, host: str = "0.0.0.0", engines: "list | None" = None
+) -> grpc.aio.Server:
     server = grpc.aio.server(
         options=[
             ("grpc.max_send_message_length", 512 * 1024 * 1024),
             ("grpc.max_receive_message_length", 512 * 1024 * 1024),
         ]
     )
-    server.add_generic_rpc_handlers((_handlers(SchedulerServicer(engine)),))
+    server.add_generic_rpc_handlers(
+        (_handlers(SchedulerServicer(engine, engines=engines)),)
+    )
     bound = server.add_insecure_port(f"{host}:{port}")
     await server.start()
     logger.info("worker gRPC listening on %s:%d", host, bound)

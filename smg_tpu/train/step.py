@@ -37,6 +37,7 @@ def make_train_step(
     rules: ShardingRules | None = None,
     learning_rate: float = 1e-4,
     use_ring_attention: bool | None = None,
+    num_microbatches: int | None = None,
 ):
     """Returns (init_fn, step_fn); both jitted with explicit shardings.
 
@@ -47,8 +48,20 @@ def make_train_step(
     step: slicing a sequence-sharded array makes it unevenly sharded, and the
     resulting pad lanes poison gradients (observed NaN in the embed grad on a
     2-way sp mesh).
+
+    Pipeline parallelism: a mesh with pp>1 shards the stacked layer dim over
+    ``pp`` (each stage owns L/pp layers and their optimizer moments) and runs
+    the layer stack as a microbatch pipeline (``smg_tpu/parallel/pipeline.py``).
+    ``num_microbatches`` defaults to 2*pp (bubble = (pp-1)/(M+pp-1)).
     """
     rules = rules or ShardingRules()
+    pp = mesh.shape.get("pp", 1) if hasattr(mesh, "shape") else 1
+    if pp > 1 and rules.rules.get("layers") is None:
+        # stage-shard the stacked per-layer params (and, via shape matching,
+        # their adamw moments)
+        rules = ShardingRules(rules={**rules.rules, "layers": "pp"})
+    if num_microbatches is None:
+        num_microbatches = 2 * pp if pp > 1 else 1
     tx = optax.adamw(learning_rate)
 
     param_axes = module.logical_axes(cfg)
@@ -67,8 +80,13 @@ def make_train_step(
         use_ring_attention = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
     ring_mesh = mesh if use_ring_attention else None
 
+    pp_mesh = mesh if pp > 1 else None
+
     def loss_fn(params, tokens, targets, mask):
-        logits = module.forward_train(params, cfg, inv_freq, tokens, ring_mesh=ring_mesh)
+        logits = module.forward_train(
+            params, cfg, inv_freq, tokens, ring_mesh=ring_mesh,
+            pp_mesh=pp_mesh, num_microbatches=num_microbatches,
+        )
         m = mask.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

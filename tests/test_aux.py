@@ -182,3 +182,89 @@ def test_service_discovery_add_remove():
         assert registry.get("k8s-w0") is not None
 
     asyncio.run(go())
+
+
+# ---- config validation layer (reference: ConfigValidator,
+# model_gateway/src/config/validation.rs) ----
+
+
+def test_validate_engine_config_catches_mesh_mismatches():
+    from smg_tpu.config import ConfigError, validate_engine_config
+    from smg_tpu.config.validation import raise_on_errors
+    from smg_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from smg_tpu.models.config import tiny_test_config
+
+    sched = SchedulerConfig(
+        max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+        prefill_token_buckets=(32, 64), decode_batch_buckets=(4,),
+    )
+    ok = EngineConfig(
+        model=tiny_test_config(),
+        parallel=ParallelConfig(tp=2),
+        cache=CacheConfig(num_pages=64, auto_size=False, dtype="float32"),
+        scheduler=sched, dtype="float32",
+    )
+    assert [i for i in validate_engine_config(ok) if i.severity == "error"] == []
+
+    # tp=3 divides neither heads (8) nor ffn (256 yes, but heads no)
+    bad_tp = EngineConfig(
+        model=tiny_test_config(), parallel=ParallelConfig(tp=3),
+        cache=CacheConfig(num_pages=64, auto_size=False, dtype="float32"),
+        scheduler=sched, dtype="float32",
+    )
+    errs = [i for i in validate_engine_config(bad_tp) if i.severity == "error"]
+    assert any("num_heads" in i.message for i in errs)
+
+    # pp=3 does not divide 4 layers
+    bad_pp = EngineConfig(
+        model=tiny_test_config(), parallel=ParallelConfig(pp=3),
+        cache=CacheConfig(num_pages=64, auto_size=False, dtype="float32"),
+        scheduler=sched, dtype="float32",
+    )
+    assert any("num_layers" in str(i) for i in validate_engine_config(bad_pp))
+
+    # ep on a dense model
+    bad_ep = EngineConfig(
+        model=tiny_test_config(), parallel=ParallelConfig(ep=2),
+        cache=CacheConfig(num_pages=64, auto_size=False, dtype="float32"),
+        scheduler=sched, dtype="float32",
+    )
+    assert any("dense" in str(i) for i in validate_engine_config(bad_ep))
+
+    # pool too small for a single max-length sequence -> Engine refuses
+    import pytest as _pytest
+
+    bad_pages = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(num_pages=4, auto_size=False, dtype="float32"),
+        scheduler=sched, dtype="float32",
+    )
+    with _pytest.raises(ConfigError):
+        raise_on_errors(validate_engine_config(bad_pages))
+
+
+def test_validate_gateway_config():
+    from smg_tpu.config import validate_gateway_config
+
+    assert validate_gateway_config(policy="round_robin", workers=["h:1"]) == []
+    assert any(
+        i.field == "policy"
+        for i in validate_gateway_config(policy="nope")
+    )
+    # PD requires both legs
+    assert any(
+        "PD" in i.message
+        for i in validate_gateway_config(prefill_workers=["h:1"])
+    )
+    # unsupported scheme
+    assert any(
+        "scheme" in i.message
+        for i in validate_gateway_config(workers=["ftp://x"])
+    )
+    # http scheme = proxy transport, valid
+    assert validate_gateway_config(workers=["http://x:8000"]) == []

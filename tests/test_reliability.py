@@ -82,7 +82,7 @@ def _gateway(workers):
     t = threading.Thread(target=loop.run_forever, daemon=True)
     t.start()
 
-    def run(coro, timeout=60):
+    def run(coro, timeout=180):  # generous: first-compiles under CI load
         return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
 
     tc = run(_setup())
@@ -155,21 +155,22 @@ def test_drain_before_remove():
     loop, ctx, tc, run = _gateway([w0, w1])
     try:
         async def go():
-            # occupy w0 with a slow stream (round_robin: find it)
-            stream_task = None
-            for _ in range(4):
-                t = asyncio.ensure_future(tc.post("/v1/chat/completions", json={
-                    "model": "tiny-test",
-                    "messages": [{"role": "user", "content": "w5 w6"}],
-                    "max_tokens": 10, "temperature": 0, "ignore_eos": True,
-                    "stream": True,
-                }))
-                await asyncio.sleep(0.15)
+            # occupy w0 with a slow stream — pin selection by draining w1
+            # for the setup call (deterministic; the old round_robin hunt
+            # raced with selection state left by earlier tests)
+            w1.draining = True
+            stream_task = asyncio.ensure_future(tc.post("/v1/chat/completions", json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "w5 w6"}],
+                "max_tokens": 10, "temperature": 0, "ignore_eos": True,
+                "stream": True,
+            }))
+            for _ in range(600):  # first-compile under CI load can be slow
                 if w0.load > 0:
-                    stream_task = t
                     break
-                (await t).close()
-            assert stream_task is not None, "slow worker never selected"
+                await asyncio.sleep(0.05)
+            w1.draining = False
+            assert w0.load > 0, "slow worker never engaged"
 
             # remove with drain while the stream is live
             del_task = asyncio.ensure_future(

@@ -203,3 +203,115 @@ def test_get_tokenizer_bundle_over_grpc(rpc):
     assert tok is not None
     assert tok.encode("w5 w6") == [5, 6]
     assert tok.decode([7, 8]) == "w7 w8"
+
+
+# ---- external DP dispatch (data_parallel_rank; reference
+# sglang_scheduler.proto:157-158 + dp_min_token.rs) ----
+
+
+@pytest.fixture(scope="module")
+def dp_rpc():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=120):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    engines = [make_engine(), make_engine()]
+    for e in engines:
+        e.start()
+
+    async def _setup():
+        server = await serve_worker_async(
+            None, port=0, host="127.0.0.1", engines=engines
+        )
+        client = GrpcWorkerClient(f"127.0.0.1:{server._bound_port}")
+        return server, client
+
+    server, client = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run = run
+    h.client = client
+    h.engines = engines
+    yield h
+    run(client.close())
+    run(server.stop(grace=None))
+    loop.call_soon_threadsafe(loop.stop)
+    for e in engines:
+        e.stop()
+
+
+def test_dp_model_info_reports_dp_size(dp_rpc):
+    info = dp_rpc.run(dp_rpc.client.get_model_info())
+    assert info["dp_size"] == 2
+    loads = dp_rpc.run(dp_rpc.client.get_loads())
+    assert loads["dp_queued_tokens"] == [0, 0]
+
+
+def test_dp_pinned_rank_routes_to_that_replica(dp_rpc):
+    async def go(rank, rid):
+        req = WorkerGenerateRequest(
+            rid=rid, input_ids=list(range(5, 25)),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=4, ignore_eos=True),
+            data_parallel_rank=rank,
+        )
+        toks = []
+        async for ch in dp_rpc.client.generate(req):
+            toks.extend(ch.token_ids)
+        return toks
+
+    t0 = dp_rpc.run(go(0, "dp-0"))
+    t1 = dp_rpc.run(go(1, "dp-1"))
+    assert len(t0) == 4 and len(t1) == 4
+    # replicas are identical models with identical seeds: same output, and
+    # each replica's decode counter moved
+    assert dp_rpc.engines[0].scheduler.num_decode_tokens > 0
+    assert dp_rpc.engines[1].scheduler.num_decode_tokens > 0
+
+
+def test_dp_out_of_range_rank_is_an_error(dp_rpc):
+    async def go():
+        req = WorkerGenerateRequest(
+            rid="dp-bad", input_ids=list(range(5, 15)),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=2, ignore_eos=True),
+            data_parallel_rank=7,
+        )
+        async for _ in dp_rpc.client.generate(req):
+            pass
+
+    with pytest.raises(RuntimeError, match="out of range"):
+        dp_rpc.run(go())
+
+
+def test_dp_load_manager_and_min_token_policy():
+    from smg_tpu.policies.dp import DpLoadManager, MinimumTokensPolicy
+
+    class W:
+        worker_id = "w1"
+        dp_size = 3
+
+    pol = MinimumTokensPolicy()
+    w = W()
+    # fills ranks in least-loaded order with atomic increments
+    assert pol.select_dp_rank(w, 100) == 0
+    assert pol.select_dp_rank(w, 10) == 1
+    assert pol.select_dp_rank(w, 10) == 2
+    assert pol.select_dp_rank(w, 10) == 1  # 10 < 20 <= 100
+    assert pol.manager.loads("w1", 3) == [100, 20, 10]
+    pol.release(w, 0, 100)
+    assert pol.select_dp_rank(w, 5) == 0
+    # dp_size 1 workers are never pinned
+    class W1:
+        worker_id = "w2"
+        dp_size = 1
+
+    assert pol.select_dp_rank(W1(), 50) is None
+    # worker-reported baselines shift selection
+    mgr = DpLoadManager()
+    mgr.seed("w3", [1000, 0])
+    assert mgr.select_and_increment_lowest("w3", 2, 10) == 1
