@@ -1,30 +1,37 @@
-"""Benchmark: decode throughput of the in-tree TPU engine.
+"""Benchmark: decode throughput + TTFT of the in-tree TPU engine.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Measures steady-state decode tokens/sec/chip through the full engine
-(continuous-batching scheduler + paged KV + fused sampling) on a
-Llama-3.2-1B-class model (bf16, random weights — tokenizer-free token-id
-workload, which is exactly what the gateway's gRPC path ships to workers;
-SURVEY.md §0 "workers only see token IDs").
+Measures, on a Llama-3.2-1B-class model (bf16, random weights — tokenizer-free
+token-id workload, which is exactly what the gateway's gRPC path ships to
+workers; SURVEY.md §0 "workers only see token IDs"):
+
+  * steady-state decode tokens/sec/chip through the full engine
+    (continuous-batching scheduler + paged KV + fused sampling),
+  * prefill TTFT for a 512-token prompt (post-compile, the serving number),
+  * a long-context (4096-token) kernel A/B: Pallas page-streaming decode
+    attention vs the XLA gather path, at the shape where the gather
+    materializes ~131k tokens per layer,
+  * an HBM roofline accounting (decode is memory-bound: every step re-reads
+    the weights plus the live KV pages) against the v5e's 819 GB/s.
 
 Baseline: the reference's CI-gated e2e floor is 12 output tok/s per request
 stream (BASELINE.md, `test_regular_perf.py:27`) with ~32 concurrent requests
 per H100 worker => ~384 tok/s/GPU floor.  vs_baseline = value / 384.
 
-Robustness (the round-1 lesson): this host carries an always-on remote-TPU
-PJRT plugin registered by an ambient sitecustomize that, when its tunnel is
-wedged, makes ``import jax``/``jax.devices()`` hang or raise for EVERY
-process that inherits the ambient environment.  So the __main__ guard is an
-orchestrator that never imports jax itself: it probes the backend in a
-throwaway subprocess with a hard timeout (one retry — the tunnel
-occasionally drops a request), then runs the real benchmark in a child
-process either on TPU (ambient env, probe proved it healthy) or on CPU
-(sanitized env: sitecustomize entry stripped from PYTHONPATH, plugin's
-trigger env var removed, JAX_PLATFORMS=cpu).  A TPU child that dies or
-stalls mid-run falls back to the CPU child, so a JSON line is always
-emitted with rc=0.
+HONESTY CONTRACT (the round-2 lesson): the bench slot records TPU numbers
+only.  The ambient remote-TPU PJRT plugin is flaky, so the probe retries over
+several minutes — but if the TPU truly cannot initialize, this script emits
+``{"metric": "tpu_unavailable", ...}`` and exits non-zero instead of dressing
+a CPU smoke run up as a result.  (The CPU smoke still runs for diagnostics
+and is embedded under ``"cpu_smoke"`` — clearly labelled, never the metric.)
+
+Process hygiene: the __main__ orchestrator never imports jax itself — a
+wedged plugin tunnel can hang ``import jax`` for every process that inherits
+the ambient environment.  Probing and measuring happen in bounded child
+processes; the CPU child gets a sanitized env (sitecustomize stripped,
+JAX_PLATFORMS=cpu).
 """
 
 from __future__ import annotations
@@ -35,25 +42,24 @@ import subprocess
 import sys
 import time
 
-# v5e HBM bandwidth, bytes/sec — roofline denominator for the utilization
-# metric (decode is memory-bound: each model step re-reads the weights and
-# the active KV pages).
+# v5e HBM bandwidth, bytes/sec — roofline denominator.
 _HBM_BYTES_PER_SEC = {"tpu": 819e9, "cpu": None}
 _BASELINE_TOK_S = 384.0  # reference CI floor: 12 tok/s/stream x 32 streams
-
 
 # single source of truth for env sanitation lives next to the other driver
 # entry point; both files sit at the repo root so this import always resolves
 from __graft_entry__ import _repo_root, _sanitized_env  # noqa: E402
 
 
-def _probe_tpu(timeouts: tuple = (120.0, 60.0)) -> bool:
-    """True iff a TPU backend initializes in a subprocess within bounds."""
+def _probe_tpu(timeouts: tuple = (120.0, 90.0, 60.0, 60.0, 60.0),
+               sleep_between: float = 15.0) -> bool:
+    """True iff a TPU backend initializes in a subprocess within bounds.
+    Retries over ~6.5 minutes: the plugin tunnel is flaky, not absent."""
     code = (
         "import jax; ds = jax.devices(); "
         "print('PLATFORMS:' + ','.join(sorted({d.platform for d in ds})))"
     )
-    for timeout_s in timeouts:
+    for i, timeout_s in enumerate(timeouts):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -62,11 +68,25 @@ def _probe_tpu(timeouts: tuple = (120.0, 60.0)) -> bool:
                 timeout=timeout_s,
                 cwd=_repo_root(),
             )
+            if r.returncode == 0 and "tpu" in r.stdout:
+                return True
+            sys.stderr.write(
+                f"[bench] probe {i + 1}/{len(timeouts)}: rc={r.returncode} "
+                f"out={r.stdout.strip()!r} err={r.stderr.strip()[-200:]!r}\n"
+            )
         except subprocess.TimeoutExpired:
-            continue
-        if r.returncode == 0 and "tpu" in r.stdout:
-            return True
+            sys.stderr.write(f"[bench] probe {i + 1}/{len(timeouts)}: timeout {timeout_s}s\n")
+        if i < len(timeouts) - 1:
+            time.sleep(sleep_between)
     return False
+
+
+def _roofline(param_bytes: int, kv_bytes_per_step: float, steps_per_sec: float,
+              on_tpu: bool) -> tuple[float, float | None]:
+    hbm_gbps = steps_per_sec * (param_bytes + kv_bytes_per_step) / 1e9
+    peak = _HBM_BYTES_PER_SEC["tpu" if on_tpu else "cpu"]
+    util = round(hbm_gbps * 1e9 / peak, 4) if peak else None
+    return round(hbm_gbps, 2), util
 
 
 def main(on_tpu: bool) -> None:
@@ -86,7 +106,7 @@ def main(on_tpu: bool) -> None:
     if on_tpu:
         model_cfg = llama32_1b_config()
         batch, prompt_len, gen_len = 32, 128, 64
-        max_seq = 1024
+        max_seq = 4096  # headroom for the long-context kernel A/B
         pages = 32 * (max_seq // 16) + 64
         dtype = "bfloat16"
         horizon = 16
@@ -112,6 +132,7 @@ def main(on_tpu: bool) -> None:
         dtype=dtype,
     )
     engine = Engine(cfg)
+    ps = cfg.cache.page_size
 
     rng = np.random.default_rng(0)
     prompts = [
@@ -141,28 +162,85 @@ def main(on_tpu: bool) -> None:
     run_round("warmup")  # compile
     engine.flush_cache()
 
+    # ---- TTFT: one 512-token prompt, post-compile (the serving number) ----
+    ttft_len = 512 if on_tpu else 32
+    ttft_prompt = rng.integers(10, model_cfg.vocab_size - 10, ttft_len).tolist()
+    got_first = []
+
+    def ttft_cb(out):
+        if out.new_token_ids and not got_first:
+            got_first.append(time.perf_counter())
+
+    ttft_ms = None
+    for rep in range(2):  # rep 0 warms the single-request prefill shape
+        got_first.clear()
+        engine.submit(ttft_prompt, SamplingParams(temperature=0.0, max_new_tokens=4,
+                                                  ignore_eos=True),
+                      rid=f"ttft-{rep}", on_output=ttft_cb)
+        t0 = time.perf_counter()
+        while not got_first:
+            engine.step()
+            if time.perf_counter() - t0 > 300:
+                raise TimeoutError("ttft measurement stuck")
+        ttft_ms = (got_first[0] - t0) * 1e3
+        for _ in range(gen_len):  # drain
+            if not engine.scheduler.has_work():
+                break
+            engine.step()
+        engine.flush_cache()
+
+    # ---- steady-state decode throughput through the full engine ----
     dt, _ = run_round("bench")
     total_new = batch * gen_len
     tput = total_new / dt
 
-    # Roofline accounting: every model step streams the full weights from
-    # HBM plus the live KV pages of each active sequence.
     param_bytes = sum(x.nbytes for x in jax.tree.leaves(engine.runner.params))
     kv_itemsize = 2 if dtype == "bfloat16" else 4
+    kv_bytes_tok = (model_cfg.num_layers * model_cfg.num_kv_heads
+                    * model_cfg.head_dim * 2 * kv_itemsize)
     mean_ctx = prompt_len + gen_len / 2
-    kv_bytes_per_step = (
-        batch
-        * mean_ctx
-        * model_cfg.num_layers
-        * model_cfg.num_kv_heads
-        * model_cfg.head_dim
-        * 2  # K and V
-        * kv_itemsize
+    hbm_gbps, hbm_util = _roofline(
+        param_bytes, batch * mean_ctx * kv_bytes_tok, tput / batch, on_tpu
     )
-    steps_per_sec = tput / batch  # each model step emits `batch` tokens
-    hbm_gbps = steps_per_sec * (param_bytes + kv_bytes_per_step) / 1e9
-    peak = _HBM_BYTES_PER_SEC["tpu" if on_tpu else "cpu"]
-    hbm_util = round(hbm_gbps * 1e9 / peak, 4) if peak else None
+
+    # ---- long-context kernel A/B: pallas page-streaming vs XLA gather ----
+    # Direct runner.decode_multi at B x 4096-token contexts — the shape where
+    # the gather path materializes B*mp*ps tokens per layer.  Flipping
+    # runner.attn_impl + clearing the compile cache swaps the kernel under an
+    # otherwise identical jitted step.
+    long_ctx = {}
+    if on_tpu:
+        runner = engine.runner
+        mp = max_seq // ps  # 256 pages -> 4096-token context
+        perm = rng.permutation(pages - 1)[: batch * mp] + 1  # skip garbage page 0
+        page_tables = perm.reshape(batch, mp).astype(np.int32)
+        toks = np.ones(batch, np.int32)
+        pos = np.full(batch, max_seq - horizon - 1, np.int32)
+        temps = np.zeros(batch, np.float32)
+        topks = np.full(batch, -1, np.int32)
+        topps = np.ones(batch, np.float32)
+        minps = np.zeros(batch, np.float32)
+        kv_long = batch * (max_seq - horizon) * kv_bytes_tok
+
+        saved_impl = runner.attn_impl
+        for impl in ("pallas", "xla"):
+            runner.attn_impl = impl
+            runner.invalidate_compiled("decode_multi")
+            try:
+                runner.decode_multi(toks, pos, page_tables, temps, topks, topps,
+                                    minps, horizon)  # compile
+                reps, t0 = 8, time.perf_counter()
+                for _ in range(reps):
+                    runner.decode_multi(toks, pos, page_tables, temps, topks,
+                                        topps, minps, horizon)
+                dt_k = (time.perf_counter() - t0) / reps
+                k_tput = batch * horizon / dt_k
+                g, u = _roofline(param_bytes, kv_long, k_tput / batch, on_tpu)
+                long_ctx[impl] = {"tok_s": round(k_tput, 2), "hbm_gbps": g,
+                                  "hbm_util": u}
+            except Exception as e:  # a kernel failure must not void the bench
+                long_ctx[impl] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        runner.attn_impl = saved_impl
 
     result = {
         "metric": "decode_tokens_per_sec_per_chip"
@@ -171,8 +249,11 @@ def main(on_tpu: bool) -> None:
         "value": round(tput, 2),
         "unit": "tok/s",
         "vs_baseline": round(tput / _BASELINE_TOK_S, 3),
-        "hbm_gbps": round(hbm_gbps, 2),
+        "platform": "tpu" if on_tpu else "cpu",
+        "ttft_ms_512tok" if on_tpu else "ttft_ms_32tok": round(ttft_ms, 1),
+        "hbm_gbps": hbm_gbps,
         "hbm_util": hbm_util,
+        "long_ctx_4096": long_ctx or None,
         "batch": batch,
         "gen_len": gen_len,
         "param_bytes": param_bytes,
@@ -180,12 +261,12 @@ def main(on_tpu: bool) -> None:
     print(json.dumps(result))
 
 
-def _salvage_result(stdout) -> bool:
-    """Emit the last valid result line from a child's captured stdout, if any.
+def _salvage_result(stdout) -> dict | None:
+    """Return the last valid result record from a child's captured stdout.
     A child that completed its measurement but died/stalled in teardown (the
     wedged-plugin scenario) still gets its number recorded."""
     if not stdout:
-        return False
+        return None
     if isinstance(stdout, bytes):
         stdout = stdout.decode(errors="replace")
     for line in reversed(stdout.splitlines()):
@@ -194,15 +275,14 @@ def _salvage_result(stdout) -> bool:
         except ValueError:
             continue
         if isinstance(rec, dict) and "metric" in rec:
-            print(line)
-            return True
-    return False
+            return rec
+    return None
 
 
-def _run_child(mode: str, timeout_s: float) -> bool:
-    """Run the benchmark child; forward exactly ONE JSON line from its stdout
-    (stderr streams through for progress).  Teardown stalls/crashes after the
-    result line are tolerated via _salvage_result."""
+def _run_child(mode: str, timeout_s: float) -> dict | None:
+    """Run the benchmark child; return its result record (stderr streams
+    through for progress).  Teardown stalls/crashes after the result line are
+    tolerated via _salvage_result."""
     env = dict(os.environ) if mode == "tpu" else _sanitized_env()
     env["SMG_BENCH_MODE"] = mode
     try:
@@ -224,6 +304,22 @@ if __name__ == "__main__":
     if mode:
         main(on_tpu=(mode == "tpu"))
         sys.exit(0)
-    if _probe_tpu() and _run_child("tpu", timeout_s=1500):
-        sys.exit(0)
-    sys.exit(0 if _run_child("cpu", timeout_s=900) else 1)
+    if _probe_tpu():
+        rec = _run_child("tpu", timeout_s=2400)
+        if rec is not None:
+            print(json.dumps(rec))
+            sys.exit(0)
+        sys.stderr.write("[bench] TPU child produced no result\n")
+    # TPU unavailable or the TPU run failed: say so — the CPU smoke is a
+    # diagnostic embedded in the record, never the headline metric.
+    smoke = _run_child("cpu", timeout_s=900)
+    print(json.dumps({
+        "metric": "tpu_unavailable",
+        "value": 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": "TPU backend failed to initialize (probe retried ~6min) "
+                  "or the TPU bench child produced no result",
+        "cpu_smoke": smoke,
+    }))
+    sys.exit(1)

@@ -55,6 +55,12 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
                    help="enable HA mesh gossip on this port")
     g.add_argument("--mesh-seed", action="append", default=[], dest="mesh_seeds",
                    help="mesh seed peer host:port (repeatable)")
+    g.add_argument("--plugins", action="append", default=[],
+                   help="middleware plugin: /path/plug.py or dotted module "
+                        "(repeatable; reference: the WASM component host)")
+    g.add_argument("--plugin-fail-closed", action="store_true",
+                   help="reject requests when a plugin hook faults "
+                        "(default: fail-open, log and continue)")
     g.add_argument("--log-level", default="INFO")
     g.add_argument("--prometheus-port", type=int, default=None)
 

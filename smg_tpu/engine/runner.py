@@ -179,6 +179,18 @@ class ModelRunner:
             return "xla"
         return "auto"
 
+    def invalidate_compiled(self, kind: str | None = None) -> None:
+        """Drop compiled step functions (all, or those whose cache key starts
+        with ``kind``, e.g. "decode_multi").  Needed after flipping
+        ``attn_impl``: the kernel choice is baked in at trace time and is
+        deliberately NOT part of the cache key (normal operation never flips
+        it for a live shape — only benchmarks do)."""
+        if kind is None:
+            self._compiled.clear()
+        else:
+            for k in [k for k in self._compiled if k[0] == kind]:
+                del self._compiled[k]
+
     def _attn_impl_for(self, B: int, mp: int) -> str:
         """Per-shape kernel choice.  Short contexts: XLA's fused
         gather+softmax wins (fused-lane layout makes the gather

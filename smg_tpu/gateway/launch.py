@@ -121,6 +121,9 @@ async def _run_gateway(args) -> int:
     )
     if getattr(args, "provider_config", None):
         ctx.providers.load_config(args.provider_config)
+    if getattr(args, "plugins", None):
+        ctx.load_plugins(args.plugins,
+                         fail_open=not getattr(args, "plugin_fail_closed", False))
 
     if args.command == "serve":
         from smg_tpu.gateway.worker_client import InProcWorkerClient

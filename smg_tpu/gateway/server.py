@@ -88,6 +88,23 @@ class AppContext:
         self.mcp = McpRegistry()
         self.responses = ResponsesHandler(self.router, self.storage, self.mcp)
         self.discovery = None  # attached by build_app when running in-cluster
+        # Plugin host (reference: wasm component host) — None until the
+        # operator loads modules via --plugins; middleware no-ops without it.
+        self.plugins = None
+
+    def load_plugins(self, specs, fail_open: bool = True):
+        """Load middleware plugins (file paths or dotted modules)."""
+        from smg_tpu.plugins import PluginHost
+
+        if self.plugins is None:
+            self.plugins = PluginHost(fail_open=fail_open)
+        else:
+            # fail-closed is security-relevant: the latest caller's choice
+            # must win, not be silently dropped on an existing host
+            self.plugins.fail_open = fail_open
+        for spec in specs:
+            self.plugins.load(spec)
+        return self.plugins
 
 
 INFERENCE_ROUTES = frozenset(

@@ -2,21 +2,33 @@
 multi-chip sharding — runs without TPU hardware (SURVEY.md §4 takeaway: mock
 workers + CPU-backed engine tests mirror the reference's GPU-free CI tiers).
 
-Must run before the first ``import jax`` anywhere in the test session.
+Self-defending against the ambient remote-TPU PJRT plugin: some installs
+register it via sitecustomize at interpreter start (importing jax with
+JAX_PLATFORMS=axon already in the env), so merely setting the env var here
+is too late.  As long as jax's backends are not yet *initialized*, flipping
+the ``jax_platforms`` config narrows backend init to the (local, safe) CPU
+client — the same rescue ``__graft_entry__.entry`` uses.
 """
 
 import os
 
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-# Some installs register an always-on TPU plugin that ignores JAX_PLATFORMS;
-# pin the default device to CPU so tests never touch real accelerators.
+# sitecustomize may have imported jax before this file ran, capturing
+# JAX_PLATFORMS=axon; override the live config before any backend spins up.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+# Belt and braces: pin the default device to CPU so tests never touch real
+# accelerators even if a plugin platform slipped through.
 try:
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
 except RuntimeError:

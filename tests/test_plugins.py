@@ -1,0 +1,208 @@
+"""Plugin host tests: loading, hook ordering, reject/modify actions through
+the real HTTP app, and fault isolation (reference: the WASM component host,
+``crates/wasm/src/interface/spec.wit`` + ``model_gateway/tests`` wasm tier)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.plugins import Continue, Modify, PluginHost, PluginRequest, PluginResponse, Reject
+
+
+# ---------------------------------------------------------------- unit level
+
+def test_load_from_file(tmp_path):
+    p = tmp_path / "plug.py"
+    p.write_text(
+        "from smg_tpu.plugins import Continue\n"
+        "def on_request(req):\n"
+        "    return Continue()\n"
+    )
+    host = PluginHost()
+    loaded = host.load(str(p))
+    assert loaded.has_on_request and not loaded.has_on_response
+    assert len(host.plugins) == 1
+
+
+def test_load_rejects_hookless_module(tmp_path):
+    p = tmp_path / "empty.py"
+    p.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="exports neither"):
+        PluginHost().load(str(p))
+
+
+def test_on_request_first_reject_wins_and_modify_accumulates(tmp_path):
+    host = PluginHost()
+
+    class ModPlug:
+        @staticmethod
+        def on_request(req):
+            return Modify(headers_set={"X-Tag": "a"})
+
+    class RejPlug:
+        @staticmethod
+        def on_request(req):
+            return Reject(403, "nope")
+
+    class NeverPlug:
+        @staticmethod
+        def on_request(req):
+            raise AssertionError("must not run after a reject")
+
+    from smg_tpu.plugins.host import LoadedPlugin
+
+    host.plugins = [
+        LoadedPlugin("mod", ModPlug),
+        LoadedPlugin("rej", RejPlug),
+        LoadedPlugin("never", NeverPlug),
+    ]
+    req = PluginRequest(method="GET", path="/health")
+    action = asyncio.run(host.on_request(req))
+    assert isinstance(action, Reject) and action.status == 403
+    assert req.headers["x-tag"] == "a"  # modify before the reject still applied
+
+
+def test_fault_isolation_fail_open_and_closed():
+    class Boom:
+        @staticmethod
+        def on_request(req):
+            raise RuntimeError("plugin bug")
+
+    from smg_tpu.plugins.host import LoadedPlugin
+
+    open_host = PluginHost(fail_open=True)
+    open_host.plugins = [LoadedPlugin("boom", Boom)]
+    action = asyncio.run(open_host.on_request(PluginRequest("GET", "/")))
+    assert isinstance(action, Continue)
+
+    closed_host = PluginHost(fail_open=False)
+    closed_host.plugins = [LoadedPlugin("boom", Boom)]
+    action = asyncio.run(closed_host.on_request(PluginRequest("GET", "/")))
+    assert isinstance(action, Reject) and action.status == 500
+
+
+def test_async_hook_and_timeout():
+    class Slow:
+        @staticmethod
+        async def on_request(req):
+            await asyncio.sleep(5)
+            return Continue()
+
+    from smg_tpu.plugins.host import LoadedPlugin
+
+    host = PluginHost(fail_open=True, hook_timeout_s=0.05)
+    host.plugins = [LoadedPlugin("slow", Slow)]
+    action = asyncio.run(host.on_request(PluginRequest("GET", "/")))
+    assert isinstance(action, Continue)  # timeout treated as fault, fail-open
+
+
+def test_on_response_modify():
+    class Stamp:
+        @staticmethod
+        def on_response(resp):
+            return Modify(headers_set={"X-Stamped": "yes"}, status=202)
+
+    from smg_tpu.plugins.host import LoadedPlugin
+
+    host = PluginHost()
+    host.plugins = [LoadedPlugin("stamp", Stamp)]
+    resp = PluginResponse(status=200)
+    action = asyncio.run(host.on_response(resp))
+    assert isinstance(action, Continue)
+    assert resp.status == 202 and resp.headers["x-stamped"] == "yes"
+
+
+# ------------------------------------------------------------- through HTTP
+
+@pytest.fixture()
+def plugin_gateway(tmp_path):
+    """App with a reject-by-header plugin and a response-stamping plugin."""
+    plug = tmp_path / "guard.py"
+    plug.write_text(
+        "from smg_tpu.plugins import Continue, Modify, Reject\n"
+        "def on_request(req):\n"
+        "    if req.headers.get('x-block') == '1':\n"
+        "        return Reject(451, 'blocked by guard')\n"
+        "    return Continue()\n"
+        "def on_response(resp):\n"
+        "    return Modify(headers_set={'X-Plugin-Saw': 'true'})\n"
+    )
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.load_plugins([str(plug)])
+
+    async def _setup():
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=60)
+
+    tc = run(_setup())
+    yield run, tc, ctx
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_http_plugin_reject(plugin_gateway):
+    run, tc, _ = plugin_gateway
+
+    async def go():
+        resp = await tc.get("/health", headers={"X-Block": "1"})
+        return resp.status, await resp.json()
+
+    status, body = run(go())
+    assert status == 451
+    assert body["error"]["type"] == "plugin_rejected"
+    assert "blocked by guard" in body["error"]["message"]
+
+
+def test_http_plugin_passthrough_and_response_modify(plugin_gateway):
+    run, tc, _ = plugin_gateway
+
+    async def go():
+        resp = await tc.get("/health")
+        return resp.status, resp.headers, await resp.json()
+
+    status, headers, body = run(go())
+    assert status == 200 and body["status"] == "ok"
+    assert headers.get("X-Plugin-Saw") == "true"
+
+
+def test_http_plugin_fault_does_not_break_gateway(plugin_gateway, tmp_path):
+    run, tc, ctx = plugin_gateway
+    crash = tmp_path / "crash.py"
+    crash.write_text(
+        "def on_request(req):\n"
+        "    raise RuntimeError('I am a buggy plugin')\n"
+    )
+    ctx.load_plugins([str(crash)])
+
+    async def go():
+        resp = await tc.get("/health")
+        return resp.status
+
+    assert run(go()) == 200  # fail-open: buggy plugin logged, request served
+
+
+def test_cli_flag_wires_plugins(tmp_path):
+    """`smg launch --plugins p.py` loads the host before serving."""
+    from smg_tpu.cli import build_parser
+
+    plug = tmp_path / "p.py"
+    plug.write_text(
+        "from smg_tpu.plugins import Continue\n"
+        "def on_request(req):\n    return Continue()\n"
+    )
+    args = build_parser().parse_args(["launch", "--plugins", str(plug)])
+    assert args.plugins == [str(plug)]
+    ctx = AppContext()
+    ctx.load_plugins(args.plugins, fail_open=not args.plugin_fail_closed)
+    assert ctx.plugins is not None and len(ctx.plugins.plugins) == 1
