@@ -201,6 +201,23 @@ class ModelRunner:
             return self.attn_impl
         return "pallas" if B * mp * self.spec.page_size > 131072 else "xla"
 
+    def _prefill_impl_for(self, mp: int) -> str:
+        """Prefill kernel choice.  The XLA path gathers mp*ps tokens per
+        layer — the page table's WORST case, independent of the live prefix —
+        so the paged kernel wins once capacity is large even when the actual
+        prefix is short.  Explicit config wins; "auto" uses a capacity
+        threshold (small tables: the fused gather is relayout-free and
+        cheap)."""
+        if self.attn_impl == "xla":
+            return "xla"
+        d = self.model_cfg.head_dim
+        c = max(1, 128 // d)
+        if self.model_cfg.num_kv_heads % c or (c * d) % 128:
+            return "xla"  # lanes not 128-sliceable for the kernel
+        if self.attn_impl == "pallas":
+            return "pallas"
+        return "pallas" if mp * self.spec.page_size > 2048 else "xla"
+
     def _local_param_bytes(self) -> int:
         """Bytes of parameters resident on ONE device (the sizing unit)."""
         leaves = jax.tree.leaves(self.params)
@@ -336,7 +353,8 @@ class ModelRunner:
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
                     use_mask: bool = False, use_lora: bool = False,
                     use_ring: bool = False):
-        k = ("prefill", T, mp, use_pen, use_mask, use_lora, use_ring)
+        impl = "xla" if use_ring else self._prefill_impl_for(mp)
+        k = ("prefill", T, mp, impl, use_pen, use_mask, use_lora, use_ring)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -361,6 +379,7 @@ class ModelRunner:
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
                 lora=lora_bank, lora_gates=lora_gates, sp_mesh=sp_mesh,
+                attn_impl=impl,
             )
             logits = logits[None]
             if use_pen:

@@ -206,6 +206,7 @@ def forward_prefill(
     lora: Params | None = None,  # stacked [L, N, ...] adapter bank
     lora_gates: jnp.ndarray | None = None,  # [N] one-hot (one sequence)
     sp_mesh=None,  # Mesh: sequence-parallel ring attention over the "sp" axis
+    attn_impl: str = "xla",  # "xla" | "pallas" | "pallas_interpret" (tests)
 ):
     """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache).
 
@@ -247,6 +248,16 @@ def forward_prefill(
             from smg_tpu.parallel.ring_attention import ring_attention
 
             attn = ring_attention(q[None], k[None], v[None], sp_mesh, scale)[0]
+        elif attn_impl.startswith("pallas"):
+            # prefix-aware paged kernel: streams only the live prefix pages
+            # instead of gathering the whole mp*ps worst-case context
+            from smg_tpu.ops.pallas.prefill_attention import paged_attention_prefill
+
+            attn = paged_attention_prefill(
+                q, k.reshape(T, -1), v.reshape(T, -1), k_cache, v_cache, l,
+                page_table, prefix_len, t_real, scale,
+                interpret=(attn_impl == "pallas_interpret"),
+            )
         else:
             k_ctx, v_ctx = gather_seq_kv(
                 k_cache[l], v_cache[l], page_table, cfg.num_kv_heads
