@@ -66,6 +66,18 @@ class Engine:
         self.events = KvEventPublisher()
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.scheduler = Scheduler(self.runner, config, event_sink=self.events.publish)
+        # vision tower (VLM): jitted per grid shape, params device-resident
+        self._vision_params = None
+        self._vision_fns: dict[tuple, object] = {}
+        if config.model.vision is not None:
+            import jax
+
+            from smg_tpu.models.vit import init_vision_params
+
+            vkey = jax.random.PRNGKey(config.seed ^ 0x71510)
+            self._vision_params = jax.jit(
+                lambda k: init_vision_params(config.model.vision, k)
+            )(vkey)
         self._callbacks: dict[str, object] = {}
         self._json_filter = None  # shared TokenFilter (piece table + mask cache)
         self._lock = threading.RLock()
@@ -83,11 +95,24 @@ class Engine:
         rid: str | None = None,
         on_output=None,
         priority: int = 0,
+        mm_embeds: tuple | None = None,  # (embeds [M, E] f32, positions [M])
     ) -> str:
         rid = rid or f"req-{uuid.uuid4().hex[:16]}"
         req = EngineRequest(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling, priority=priority
         )
+        if mm_embeds is not None:
+            import numpy as np
+
+            embeds, positions = mm_embeds
+            embeds = np.asarray(embeds, np.float32)
+            positions = np.asarray(positions, np.int64)
+            if positions.size and (positions.min() < 0
+                                   or positions.max() >= len(prompt_ids)):
+                raise ValueError("mm_embeds positions out of prompt range")
+            if embeds.shape[0] != positions.shape[0]:
+                raise ValueError("mm_embeds embeds/positions length mismatch")
+            req.mm_embeds = (embeds, positions)
         if self.tokenizer is not None:
             req.detok = IncrementalDecoder(
                 self.tokenizer, skip_special_tokens=sampling.skip_special_tokens
@@ -149,6 +174,35 @@ class Engine:
         """Sequence embeddings (blocks the step loop briefly)."""
         with self._lock:
             return self.runner.embed(batches)
+
+    @property
+    def supports_vision(self) -> bool:
+        return self._vision_params is not None
+
+    def encode_image(self, pixel_values, grid: tuple) -> "object":
+        """Vision-tower encode: pre-patchified pixels [N, patch_dim] ->
+        language-space embeddings [N/merge^2, hidden] (np.float32).  The EPD
+        encode leg (reference: encoder servicer + ``stages/encode.rs``); also
+        serves colocated inline encode."""
+        import functools
+
+        import jax
+        import numpy as np
+
+        if self._vision_params is None:
+            raise ValueError("model has no vision tower")
+        vcfg = self.config.model.vision
+        key = (int(grid[0]), int(grid[1]))
+        fn = self._vision_fns.get(key)
+        if fn is None:
+            from smg_tpu.models.vit import forward_vision
+
+            fn = jax.jit(functools.partial(forward_vision, cfg=vcfg, grid=key))
+            self._vision_fns[key] = fn
+        with self._lock:
+            out = fn(self._vision_params, pixel_values=jax.numpy.asarray(
+                pixel_values, jax.numpy.float32))
+        return np.asarray(out, np.float32)
 
     # ---- LoRA adapters (reference: Load/Unload/ListLoRAAdapter RPCs) ----
 

@@ -53,6 +53,10 @@ class EngineRequest:
     penalty_synced: bool = False
     # LoRA adapter bank slot applied to this request (0 = base model)
     lora_idx: int = 0
+    # Multimodal embeddings spliced into the prompt at placeholder positions:
+    # (embeds [M, E] float32, positions [M] int32).  Reference: the EPD
+    # encode leg ships vision-tower output to prefill (``stages/encode.rs``).
+    mm_embeds: tuple | None = None
 
     @property
     def prompt_len(self) -> int:

@@ -352,9 +352,10 @@ class ModelRunner:
 
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
                     use_mask: bool = False, use_lora: bool = False,
-                    use_ring: bool = False):
+                    use_ring: bool = False, use_embeds: bool = False):
         impl = "xla" if use_ring else self._prefill_impl_for(mp)
-        k = ("prefill", T, mp, impl, use_pen, use_mask, use_lora, use_ring)
+        k = ("prefill", T, mp, impl, use_pen, use_mask, use_lora, use_ring,
+             use_embeds)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -376,10 +377,15 @@ class ModelRunner:
             if use_lora:
                 lora_bank, lora_idx = extra[i], extra[i + 1]
                 lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
+                i += 2
+            input_embeds = embeds_mask = None
+            if use_embeds:
+                input_embeds, embeds_mask = extra[i], extra[i + 1]
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
                 lora=lora_bank, lora_gates=lora_gates, sp_mesh=sp_mesh,
                 attn_impl=impl,
+                input_embeds=input_embeds, embeds_mask=embeds_mask,
             )
             logits = logits[None]
             if use_pen:
@@ -387,7 +393,8 @@ class ModelRunner:
             toks, lps = _pick_sampler()(logits, key, temp, topk, topp, minp, mask=mask)
             return toks[0], lps[0], kc, vc
 
-        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0) + (2 if use_lora else 0)
+        n_extra = ((5 if use_pen else 0) + (1 if use_mask else 0)
+                   + (2 if use_lora else 0) + (2 if use_embeds else 0))
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -738,6 +745,7 @@ class ModelRunner:
         pen: tuple | None = None,  # (counts [V], pmask [V], freq, pres, rep) scalars
         mask: np.ndarray | None = None,  # [V] bool
         lora_idx: int = 0,  # adapter slot (0 = none)
+        mm: tuple | None = None,  # (embeds [t, E] f32, emask [t] bool) mm splice
     ) -> tuple[int, float]:
         """Run one prefill chunk; returns (sampled_token, logprob)."""
         t = len(token_ids)
@@ -755,7 +763,7 @@ class ModelRunner:
         )
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
                               use_mask=mask is not None, use_lora=use_lora,
-                              use_ring=use_ring)
+                              use_ring=use_ring, use_embeds=mm is not None)
         args = [
             self.params,
             self.inv_freq,
@@ -784,6 +792,13 @@ class ModelRunner:
             args.append(jnp.asarray(mask)[None])
         if use_lora:
             args += [self._lora_bank, jnp.int32(lora_idx)]
+        if mm is not None:
+            embeds, emask = mm
+            pe = np.zeros((T, embeds.shape[1]), np.float32)
+            pe[:t] = embeds
+            pm = np.zeros(T, bool)
+            pm[:t] = emask
+            args += [jnp.asarray(pe), jnp.asarray(pm)]
         tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
 

@@ -154,9 +154,12 @@ class Scheduler:
 
                 # radix prefix match (never match the full prompt: at least
                 # one token must be computed to produce logits)
+                # mm requests bypass the radix cache entirely: placeholder
+                # token ids are identical across different images, so a
+                # token-keyed prefix match would alias distinct pixel content
                 shared_pages: list[int] = []
                 node = None
-                if self.radix is not None:
+                if self.radix is not None and req.mm_embeds is None:
                     shared_pages, node = self.radix.match_prefix(prompt[:-1])
                 matched_tokens = len(shared_pages) * self.ps
                 prompt_pages_total = math.ceil(len(prompt) / self.ps)
@@ -184,7 +187,7 @@ class Scheduler:
                 self.slots[slot] = req
 
                 remaining = len(prompt) - matched_tokens
-                if remaining > self.sched.max_prefill_tokens:
+                if remaining > self.sched.max_prefill_tokens or req.mm_embeds is not None:
                     self._prefill_solo(req, prompt, matched_tokens, outputs)
                 else:
                     group.append(req)
@@ -239,11 +242,26 @@ class Scheduler:
                 pen=pen,
                 mask=mask,
                 lora_idx=req.lora_idx,
+                mm=self._mm_chunk(req, start, len(chunk)),
             )
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)
         req.seq_len = len(prompt)
         self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
+
+    def _mm_chunk(self, req: EngineRequest, start: int, chunk_len: int):
+        """Slice the request's mm embeddings for one prefill chunk: a dense
+        [chunk_len, E] buffer + bool mask selecting placeholder rows."""
+        if req.mm_embeds is None:
+            return None
+        embeds, positions = req.mm_embeds
+        sel = (positions >= start) & (positions < start + chunk_len)
+        out = np.zeros((chunk_len, embeds.shape[1]), np.float32)
+        m = np.zeros(chunk_len, bool)
+        idx = positions[sel] - start
+        out[idx] = embeds[sel]
+        m[idx] = True
+        return out, m
 
     def _prefill_group(
         self, group: list[EngineRequest], outputs: list[StepOutput]
@@ -584,7 +602,7 @@ class Scheduler:
         full_pages = len(tokens) // self.ps
         n_shared = len(req.shared_pages)
         to_free: list[int] = []
-        if self.radix is not None and finish.reason != "error":
+        if self.radix is not None and finish.reason != "error" and req.mm_embeds is None:
             all_pages = req.shared_pages + req.owned_pages
             dupes = self.radix.insert(tokens, all_pages[:full_pages])
             for idx, page in dupes:

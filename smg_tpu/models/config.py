@@ -34,6 +34,10 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
+    # Vision tower (VLM; None = text-only).  ``image_token_id`` is the
+    # placeholder the gateway expands per image (Qwen2-VL <|image_pad|>).
+    vision: "object | None" = None  # VisionConfig (kept loose: frozen dataclass)
+    image_token_id: int | None = None
 
     @classmethod
     def from_hf_config(cls, cfg: dict, dtype: str = "bfloat16") -> "ModelConfig":
@@ -49,6 +53,23 @@ class ModelConfig:
         eos = cfg.get("eos_token_id", 2)
         eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
         num_heads = cfg["num_attention_heads"]
+        vision = None
+        vc = cfg.get("vision_config")
+        if vc and "vl" in name:
+            from smg_tpu.models.vit import VisionConfig
+
+            vh = vc.get("embed_dim") or vc.get("hidden_size", 1280)
+            vision = VisionConfig(
+                hidden_size=vh,
+                intermediate_size=vc.get("intermediate_size") or vh * 4,
+                num_layers=vc.get("depth") or vc.get("num_hidden_layers", 32),
+                num_heads=vc.get("num_heads") or vc.get("num_attention_heads", 16),
+                patch_size=vc.get("patch_size", 14),
+                merge_size=vc.get("spatial_merge_size", 2),
+                in_channels=vc.get("in_channels", vc.get("in_chans", 3)),
+                out_hidden_size=cfg["hidden_size"],
+                dtype=dtype,
+            )
         return cls(
             arch=arch,
             vocab_size=cfg["vocab_size"],
@@ -69,6 +90,8 @@ class ModelConfig:
             num_experts=cfg.get("num_experts", cfg.get("num_routed_experts", 0)) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 0) or 0,
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
+            vision=vision,
+            image_token_id=cfg.get("image_token_id"),
         )
 
     @classmethod
@@ -137,9 +160,26 @@ def tiny_moe_config() -> ModelConfig:
     )
 
 
+def tiny_vlm_config() -> ModelConfig:
+    """Tiny Qwen2-VL-style VLM for CPU tests: tiny LLM + tiny vision tower.
+    Placeholder token 500 plays <|image_pad|> (reference: the EPD encode leg,
+    ``stages/encode.rs``)."""
+    import dataclasses
+
+    from smg_tpu.models.vit import tiny_vision_config
+
+    base = tiny_test_config()
+    return dataclasses.replace(
+        base,
+        vision=tiny_vision_config(out_hidden_size=base.hidden_size),
+        image_token_id=500,
+    )
+
+
 PRESETS = {
     "tiny": tiny_test_config,
     "tiny-moe": tiny_moe_config,
+    "tiny-vlm": tiny_vlm_config,
     "llama3.2-1b": llama32_1b_config,
     "llama3-8b": llama3_8b_config,
     "llama3-70b": llama3_70b_config,

@@ -207,6 +207,8 @@ def forward_prefill(
     lora_gates: jnp.ndarray | None = None,  # [N] one-hot (one sequence)
     sp_mesh=None,  # Mesh: sequence-parallel ring attention over the "sp" axis
     attn_impl: str = "xla",  # "xla" | "pallas" | "pallas_interpret" (tests)
+    input_embeds: jnp.ndarray | None = None,  # [T, E] mm splice rows
+    embeds_mask: jnp.ndarray | None = None,  # [T] bool: row comes from input_embeds
 ):
     """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache).
 
@@ -232,6 +234,10 @@ def forward_prefill(
     ctx_len = prefix_len + t_real
 
     h = embed_tokens(params, cfg, tokens)
+    if input_embeds is not None:
+        # multimodal splice: placeholder rows take the vision-tower output
+        # (reference: EPD encode leg shipping embeddings to prefill)
+        h = jnp.where(embeds_mask[:, None], input_embeds.astype(h.dtype), h)
 
     def layer_body(carry, xs):
         h, k_cache, v_cache = carry

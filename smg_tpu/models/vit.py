@@ -1,0 +1,197 @@
+"""Vision tower: Qwen2-VL-class ViT encoder in JAX.
+
+Reference parity target: the EPD encode leg — the reference ships pixel
+tensors to a separate *encoder servicer* whose vision tower produces
+embeddings that are spliced into the prefill leg's token stream
+(``grpc_servicer/smg_grpc_servicer/tokenspeed/encoder_servicer.py``,
+``model_gateway/src/routers/grpc/common/stages/encode.rs:1-40``).  The
+reference has no in-tree tower (it lives in the engines); this one is the
+TPU-native equivalent, designed for the MXU: patch embedding as a single
+matmul over pre-patchified pixels (the host/gateway already runs
+``multimodal.patchify``), full-attention transformer blocks in bf16-friendly
+layouts, and a 2x2 spatial-merge MLP projecting into the language model's
+hidden space (Qwen2-VL "merger").
+
+Positional scheme: 2D rotary embedding — each patch's (row, col) grid
+coordinate rotates half the head dims each, matching Qwen2-VL's
+``VisionRotaryEmbedding``.  Patch order is row-major over (gh, gw), the
+layout ``multimodal.image.patchify`` produces.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    hidden_size: int = 1280
+    intermediate_size: int = 5120
+    num_layers: int = 32
+    num_heads: int = 16
+    patch_size: int = 14
+    merge_size: int = 2
+    in_channels: int = 3
+    out_hidden_size: int = 2048  # language model hidden
+    layer_norm_eps: float = 1e-6
+    dtype: str = "float32"
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.patch_size * self.patch_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def tiny_vision_config(out_hidden_size: int = 128) -> VisionConfig:
+    """Tiny tower for CPU tests (pairs with models.config.tiny_test_config)."""
+    return VisionConfig(
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        patch_size=4, merge_size=2, out_hidden_size=out_hidden_size,
+    )
+
+
+def init_vision_params(cfg: VisionConfig, key: jax.Array) -> Params:
+    """Random-init parameters (He-style fans), HF-compatible structure."""
+    dtype = jnp.dtype(cfg.dtype)
+    ks = iter(jax.random.split(key, 4 + 8 * cfg.num_layers))
+
+    def dense(k, fan_in, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dtype)
+
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    m2 = cfg.merge_size * cfg.merge_size
+    layers = []
+    for _ in range(cfg.num_layers):
+        layers.append({
+            "ln1": {"scale": jnp.ones(H, dtype), "bias": jnp.zeros(H, dtype)},
+            "qkv_w": dense(next(ks), H, (H, 3 * H)),
+            "qkv_b": jnp.zeros(3 * H, dtype),
+            "proj_w": dense(next(ks), H, (H, H)),
+            "proj_b": jnp.zeros(H, dtype),
+            "ln2": {"scale": jnp.ones(H, dtype), "bias": jnp.zeros(H, dtype)},
+            "fc1_w": dense(next(ks), H, (H, I)),
+            "fc1_b": jnp.zeros(I, dtype),
+            "fc2_w": dense(next(ks), I, (I, H)),
+            "fc2_b": jnp.zeros(H, dtype),
+        })
+    return {
+        "patch_embed": dense(next(ks), cfg.patch_dim, (cfg.patch_dim, H)),
+        "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+        "merger": {
+            "ln_q": {"scale": jnp.ones(H, dtype), "bias": jnp.zeros(H, dtype)},
+            "mlp0_w": dense(next(ks), H * m2, (H * m2, H * m2)),
+            "mlp0_b": jnp.zeros(H * m2, dtype),
+            "mlp2_w": dense(next(ks), H * m2, (H * m2, cfg.out_hidden_size)),
+            "mlp2_b": jnp.zeros(cfg.out_hidden_size, dtype),
+        },
+    }
+
+
+def _layer_norm(x: jnp.ndarray, p: Params, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _rope_2d(x: jnp.ndarray, rows: jnp.ndarray, cols: jnp.ndarray) -> jnp.ndarray:
+    """Qwen2-VL vision rotary: first half of head dims rotates by row
+    position, second half by column.  x: [N, h, d]."""
+    N, h, d = x.shape
+    half = d // 2
+    quarter = half // 2
+    inv = 1.0 / (10000.0 ** (jnp.arange(quarter, dtype=jnp.float32) / quarter))
+    fr = rows.astype(jnp.float32)[:, None] * inv[None, :]  # [N, quarter]
+    fc = cols.astype(jnp.float32)[:, None] * inv[None, :]
+    freqs = jnp.concatenate([fr, fc], axis=-1)  # [N, half]
+    cos = jnp.cos(freqs)[:, None, :]
+    sin = jnp.sin(freqs)[:, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def forward_vision(
+    params: Params,
+    cfg: VisionConfig,
+    pixel_values: jnp.ndarray,  # [N, patch_dim] pre-patchified (row-major grid)
+    grid: tuple[int, int],  # (gh, gw) — static per compile
+) -> jnp.ndarray:
+    """Encode one image's patches -> [gh*gw / merge^2, out_hidden_size]."""
+    gh, gw = grid
+    N = gh * gw
+    H = cfg.hidden_size
+    nh, d = cfg.num_heads, cfg.head_dim
+    m = cfg.merge_size
+    scale = 1.0 / math.sqrt(d)
+
+    rows = jnp.repeat(jnp.arange(gh), gw)  # [N] row-major
+    cols = jnp.tile(jnp.arange(gw), gh)
+
+    h = pixel_values.astype(params["patch_embed"].dtype) @ params["patch_embed"]
+
+    def layer_body(h, layer):
+        hn = _layer_norm(h, layer["ln1"], cfg.layer_norm_eps)
+        qkv = hn @ layer["qkv_w"] + layer["qkv_b"]  # [N, 3H]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _rope_2d(q.reshape(N, nh, d), rows, cols)
+        k = _rope_2d(k.reshape(N, nh, d), rows, cols)
+        v = v.reshape(N, nh, d)
+        scores = jnp.einsum(
+            "qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * scale
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+        h = h + (attn.reshape(N, H).astype(h.dtype) @ layer["proj_w"]
+                 + layer["proj_b"])
+        hn = _layer_norm(h, layer["ln2"], cfg.layer_norm_eps)
+        h = h + (jax.nn.gelu(hn @ layer["fc1_w"] + layer["fc1_b"])
+                 @ layer["fc2_w"] + layer["fc2_b"])
+        return h, None
+
+    h, _ = jax.lax.scan(layer_body, h, params["layers"])
+
+    # spatial merge: each m x m block of neighboring patches becomes one
+    # language-model token (Qwen2-VL merger)
+    mg = params["merger"]
+    h = _layer_norm(h, mg["ln_q"], cfg.layer_norm_eps)
+    h = h.reshape(gh // m, m, gw // m, m, H)
+    h = jnp.transpose(h, (0, 2, 1, 3, 4)).reshape((gh // m) * (gw // m), m * m * H)
+    h = jax.nn.gelu(h @ mg["mlp0_w"] + mg["mlp0_b"])
+    return h @ mg["mlp2_w"] + mg["mlp2_b"]
+
+
+# HF checkpoint key mapping (Qwen2-VL "visual." tree) for models/weights.py —
+# documented here so the loader stays model-agnostic.  conv weights
+# [H, C, (T,) ps, ps] flatten to [patch_dim, H] with the same (C, ps, ps)
+# ordering patchify uses.
+HF_VISION_MAPPING = {
+    "patch_embed": "visual.patch_embed.proj.weight",
+    "layers.{i}.ln1": "visual.blocks.{i}.norm1",
+    "layers.{i}.qkv_w": "visual.blocks.{i}.attn.qkv.weight",
+    "layers.{i}.qkv_b": "visual.blocks.{i}.attn.qkv.bias",
+    "layers.{i}.proj_w": "visual.blocks.{i}.attn.proj.weight",
+    "layers.{i}.proj_b": "visual.blocks.{i}.attn.proj.bias",
+    "layers.{i}.ln2": "visual.blocks.{i}.norm2",
+    "layers.{i}.fc1_w": "visual.blocks.{i}.mlp.fc1.weight",
+    "layers.{i}.fc1_b": "visual.blocks.{i}.mlp.fc1.bias",
+    "layers.{i}.fc2_w": "visual.blocks.{i}.mlp.fc2.weight",
+    "layers.{i}.fc2_b": "visual.blocks.{i}.mlp.fc2.bias",
+    "merger.ln_q": "visual.merger.ln_q",
+    "merger.mlp0_w": "visual.merger.mlp.0.weight",
+    "merger.mlp0_b": "visual.merger.mlp.0.bias",
+    "merger.mlp2_w": "visual.merger.mlp.2.weight",
+    "merger.mlp2_b": "visual.merger.mlp.2.bias",
+}

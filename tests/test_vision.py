@@ -1,0 +1,180 @@
+"""Vision tower + multimodal engine plumbing (reference: the EPD encode leg —
+encoder servicer vision tower + ``stages/encode.rs`` embedding handoff)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import tiny_vlm_config
+from smg_tpu.models.vit import (
+    VisionConfig,
+    forward_vision,
+    init_vision_params,
+    tiny_vision_config,
+)
+from smg_tpu.protocols.sampling import SamplingParams
+
+
+def test_vision_tower_shapes_and_determinism():
+    cfg = tiny_vision_config(out_hidden_size=128)
+    params = init_vision_params(cfg, jax.random.PRNGKey(0))
+    gh, gw = 8, 12
+    pixels = jax.random.normal(jax.random.PRNGKey(1), (gh * gw, cfg.patch_dim))
+    out = forward_vision(params, cfg, pixels, (gh, gw))
+    m2 = cfg.merge_size**2
+    assert out.shape == (gh * gw // m2, 128)
+    assert np.all(np.isfinite(np.asarray(out)))
+    out2 = forward_vision(params, cfg, pixels, (gh, gw))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_vision_tower_position_sensitivity():
+    """2D rope: permuting the patch grid must change the output (a tower
+    ignoring positions would be permutation-equivariant after merge)."""
+    cfg = tiny_vision_config()
+    params = init_vision_params(cfg, jax.random.PRNGKey(0))
+    gh = gw = 8
+    pixels = jax.random.normal(jax.random.PRNGKey(1), (gh * gw, cfg.patch_dim))
+    base = np.asarray(forward_vision(params, cfg, pixels, (gh, gw)))
+    flipped = np.asarray(forward_vision(params, cfg, pixels[::-1], (gh, gw)))
+    assert not np.allclose(base, flipped[::-1], atol=1e-4)
+
+
+def _vlm_engine() -> Engine:
+    cfg = EngineConfig(
+        model=tiny_vlm_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=256, max_prefill_tokens=32,
+            prefill_token_buckets=(16, 32), decode_batch_buckets=(2, 4),
+        ),
+        dtype="float32",
+        model_id="tiny-vlm",
+    )
+    return Engine(cfg)
+
+
+@pytest.fixture(scope="module")
+def vlm():
+    eng = _vlm_engine()
+    yield eng
+    eng.stop()
+
+
+def _generate(eng, prompt, mm=None, n=8):
+    done = {}
+    acc = []
+
+    def cb(out):
+        acc.extend(out.new_token_ids)
+        if out.finished:
+            done["ids"] = list(acc)
+
+    eng.submit(prompt, SamplingParams(temperature=0.0, max_new_tokens=n,
+                                      ignore_eos=True),
+               rid=f"r{np.random.randint(1 << 30)}", on_output=cb, mm_embeds=mm)
+    for _ in range(200):
+        eng.step()
+        if "ids" in done:
+            return done["ids"]
+    raise TimeoutError
+
+
+def test_encode_image_and_generate(vlm):
+    """Full mm path: encode patches -> splice -> deterministic generation."""
+    vcfg = vlm.config.model.vision
+    gh, gw = 4, 8
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((gh * gw, vcfg.patch_dim)).astype(np.float32)
+    embeds = vlm.encode_image(pixels, (gh, gw))
+    n_tok = gh * gw // vcfg.merge_size**2
+    assert embeds.shape == (n_tok, vlm.config.model.hidden_size)
+
+    pad = vlm.config.model.image_token_id
+    prompt = [5, 6, 7] + [pad] * n_tok + [9, 10]
+    positions = np.arange(3, 3 + n_tok)
+    ids1 = _generate(vlm, prompt, mm=(embeds, positions))
+    ids2 = _generate(vlm, prompt, mm=(embeds, positions))
+    assert ids1 == ids2 and len(ids1) == 8
+
+    # different image content must change the generation's path or at least
+    # the spliced-state -> check logit path differs via different output
+    other = vlm.encode_image(
+        rng.standard_normal((gh * gw, vcfg.patch_dim)).astype(np.float32) * 3,
+        (gh, gw),
+    )
+    ids3 = _generate(vlm, prompt, mm=(other, positions))
+    # greedy decode CAN coincide on tiny random models, but states must differ;
+    # assert on the strongest observable: not all three identical tokens AND
+    # identical to each other by construction of a 3x-scaled image is unlikely —
+    # fall back to state check if equal
+    if ids3 == ids1:
+        e1 = np.asarray(embeds)
+        e3 = np.asarray(other)
+        assert not np.allclose(e1, e3)
+
+
+def test_mm_splice_parity_with_text(vlm):
+    """Splicing the model's OWN token embeddings at placeholder positions must
+    reproduce the text-only generation exactly — the strongest end-to-end
+    correctness check for the embedding override path."""
+    table = np.asarray(vlm.runner.params["embed"], np.float32)
+    pad = vlm.config.model.image_token_id
+    real = [11, 12, 13, 14]
+    text_prompt = [5, 6] + real + [9]
+    mm_prompt = [5, 6] + [pad] * 4 + [9]
+    embeds = table[real]
+    positions = np.arange(2, 6)
+    want = _generate(vlm, text_prompt)
+    got = _generate(vlm, mm_prompt, mm=(embeds, positions))
+    assert got == want
+
+
+def test_mm_splice_parity_chunked(vlm):
+    """Prompt longer than max_prefill_tokens: the splice must land in the
+    right chunk at the right offset."""
+    table = np.asarray(vlm.runner.params["embed"], np.float32)
+    pad = vlm.config.model.image_token_id
+    real = [21, 22, 23, 24, 25, 26]
+    base = list(range(40, 40 + 60))  # 60 tokens -> chunks of 32 + rest
+    text_prompt = base[:45] + real + base[45:51]
+    mm_prompt = base[:45] + [pad] * 6 + base[45:51]
+    positions = np.arange(45, 51)
+    want = _generate(vlm, text_prompt)
+    got = _generate(vlm, mm_prompt, mm=(table[real], positions))
+    assert got == want
+
+
+def test_mm_requests_bypass_radix_cache(vlm):
+    """Two mm requests with identical token ids but different embeds must not
+    share cached prefix state."""
+    table = np.asarray(vlm.runner.params["embed"], np.float32)
+    pad = vlm.config.model.image_token_id
+    prompt = [5, 6] + [pad] * 4 + list(range(30, 38))
+    positions = np.arange(2, 6)
+    a = _generate(vlm, prompt, mm=(table[[11, 12, 13, 14]], positions))
+    b = _generate(vlm, prompt, mm=(table[[15, 16, 17, 18]], positions))
+    # parity targets: the same prompts written out as text
+    a_want = _generate(vlm, [5, 6, 11, 12, 13, 14] + list(range(30, 38)))
+    b_want = _generate(vlm, [5, 6, 15, 16, 17, 18] + list(range(30, 38)))
+    assert a == a_want and b == b_want
+
+
+def test_hf_config_parses_vision():
+    from smg_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["Qwen2VLForConditionalGeneration"],
+        "vocab_size": 152064, "hidden_size": 2048, "intermediate_size": 11008,
+        "num_hidden_layers": 28, "num_attention_heads": 16,
+        "num_key_value_heads": 2, "image_token_id": 151655,
+        "vision_config": {"embed_dim": 1280, "depth": 32, "num_heads": 16,
+                          "patch_size": 14, "spatial_merge_size": 2,
+                          "in_channels": 3},
+    })
+    assert cfg.vision is not None
+    assert cfg.vision.out_hidden_size == 2048
+    assert cfg.image_token_id == 151655
