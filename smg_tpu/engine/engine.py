@@ -56,7 +56,10 @@ class GenerationResult:
 
 
 class Engine:
-    def __init__(self, config: EngineConfig, tokenizer=None, params=None, devices=None):
+    def __init__(
+        self, config: EngineConfig, tokenizer=None, params=None, devices=None,
+        vision_params=None,
+    ):
         from smg_tpu.config import validate_engine_config
         from smg_tpu.config.validation import raise_on_errors
 
@@ -66,18 +69,25 @@ class Engine:
         self.events = KvEventPublisher()
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.scheduler = Scheduler(self.runner, config, event_sink=self.events.publish)
-        # vision tower (VLM): jitted per grid shape, params device-resident
+        # vision tower (VLM): jitted per grid shape, params device-resident.
+        # ``vision_params`` comes from the checkpoint loader
+        # (models.weights.load_vision_params); random-init is the test path.
         self._vision_params = None
         self._vision_fns: dict[tuple, object] = {}
         if config.model.vision is not None:
-            import jax
+            if vision_params is not None:
+                import jax
 
-            from smg_tpu.models.vit import init_vision_params
+                self._vision_params = jax.device_put(vision_params)
+            else:
+                import jax
 
-            vkey = jax.random.PRNGKey(config.seed ^ 0x71510)
-            self._vision_params = jax.jit(
-                lambda k: init_vision_params(config.model.vision, k)
-            )(vkey)
+                from smg_tpu.models.vit import init_vision_params
+
+                vkey = jax.random.PRNGKey(config.seed ^ 0x71510)
+                self._vision_params = jax.jit(
+                    lambda k: init_vision_params(config.model.vision, k)
+                )(vkey)
         self._callbacks: dict[str, object] = {}
         self._json_filter = None  # shared TokenFilter (piece table + mask cache)
         self._lock = threading.RLock()
@@ -179,6 +189,11 @@ class Engine:
     def supports_vision(self) -> bool:
         return self._vision_params is not None
 
+    #: max distinct (gh, gw) grids kept compiled; beyond this the least
+    #: recently used entry is dropped (its XLA executable is GC'd).  Arbitrary
+    #: image sizes otherwise grow the compile cache without bound.
+    VISION_COMPILE_CACHE = 32
+
     def encode_image(self, pixel_values, grid: tuple) -> "object":
         """Vision-tower encode: pre-patchified pixels [N, patch_dim] ->
         language-space embeddings [N/merge^2, hidden] (np.float32).  The EPD
@@ -193,13 +208,17 @@ class Engine:
             raise ValueError("model has no vision tower")
         vcfg = self.config.model.vision
         key = (int(grid[0]), int(grid[1]))
-        fn = self._vision_fns.get(key)
-        if fn is None:
-            from smg_tpu.models.vit import forward_vision
-
-            fn = jax.jit(functools.partial(forward_vision, cfg=vcfg, grid=key))
-            self._vision_fns[key] = fn
         with self._lock:
+            fn = self._vision_fns.get(key)
+            if fn is None:
+                from smg_tpu.models.vit import forward_vision
+
+                fn = jax.jit(functools.partial(forward_vision, cfg=vcfg, grid=key))
+                while len(self._vision_fns) >= self.VISION_COMPILE_CACHE:
+                    self._vision_fns.pop(next(iter(self._vision_fns)))
+            # move-to-end: dict insertion order doubles as LRU order
+            self._vision_fns.pop(key, None)
+            self._vision_fns[key] = fn
             out = fn(self._vision_params, pixel_values=jax.numpy.asarray(
                 pixel_values, jax.numpy.float32))
         return np.asarray(out, np.float32)

@@ -753,6 +753,17 @@ class ModelRunner:
         tokens = np.zeros(T, np.int32)
         tokens[:t] = token_ids
         mp = len(page_table)
+        # Scheduler invariant the Pallas prefill kernel relies on: every
+        # chunk token's position must fit the page table (the kernel attends
+        # tokens past capacity where the XLA path drops them — divergence
+        # documented at ops/pallas/prefill_attention.py).  Fail loudly here
+        # instead of producing path-dependent attention.
+        ps = self.config.cache.page_size
+        if prefix_len + t > mp * ps:
+            raise ValueError(
+                f"prefill chunk overruns page table: prefix {prefix_len} + "
+                f"chunk {t} > {mp} pages * {ps}"
+            )
         use_lora = lora_idx > 0 and self._lora_bank is not None
         # sequence-parallel prefill: cold chunks (the long-context case — a
         # huge first chunk is exactly what sp exists for) ring-attend with the

@@ -61,10 +61,13 @@ def build_engine_from_args(args):
         model_id=args.model_path or args.model_preset,
     )
     params = None
+    vision_params = None
     if args.model_path:
-        from smg_tpu.models.weights import load_params
+        from smg_tpu.models.weights import load_params, load_vision_params
 
         params = load_params(cfg)
+        if model.vision is not None:
+            vision_params = load_vision_params(cfg)
     if cfg.tokenizer_path:
         tokenizer = load_tokenizer(cfg.tokenizer_path)
     else:
@@ -78,7 +81,8 @@ def build_engine_from_args(args):
             eos_token_id=(model.eos_token_ids or (0,))[0],
             bos_token_id=model.bos_token_id if model.bos_token_id is not None else 1,
         )
-    return Engine(cfg, params=params, tokenizer=tokenizer)
+    return Engine(cfg, params=params, tokenizer=tokenizer,
+                  vision_params=vision_params)
 
 
 def load_tokenizer(path: str | None):

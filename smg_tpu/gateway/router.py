@@ -157,6 +157,53 @@ class Router:
         decode = [w for w in candidates if w.worker_type == WorkerType.DECODE]
         return prefill, decode
 
+    async def worker_info(self, worker: Worker) -> dict:
+        """Worker model info, cached after the first fetch (static per
+        process: model identity, vision caps, page size)."""
+        info = getattr(worker, "_model_info", None)
+        if info is None:
+            info = await worker.client.get_model_info()
+            worker._model_info = info
+        return info
+
+    async def _vision_worker(self, model_id: str | None) -> tuple[Worker, dict]:
+        """Pick a worker for the encode leg (reference: EncodeStage routes to
+        encoder workers, ``stages/encode.rs``).  Dedicated ENCODE workers are
+        preferred (EPD); otherwise any vision-capable regular worker serves
+        the colocated encode."""
+        from smg_tpu.gateway.workers import WorkerType
+
+        candidates = [
+            w for w in self._candidate_workers(model_id)
+            if not getattr(w.client, "proxy_mode", False)
+        ]
+        encode_pool = [w for w in candidates if w.worker_type == WorkerType.ENCODE]
+        saw_vision_capable = False
+        saw_unknown = False
+        # dedicated ENCODE workers first (EPD), then any vision-capable
+        # worker — an unavailable encode pool must not mask capable regulars
+        ordered = encode_pool + [w for w in candidates if w not in encode_pool]
+        for w in ordered:
+            try:
+                info = await self.worker_info(w)
+            except Exception:
+                saw_unknown = True  # unreachable: capability undetermined
+                continue
+            if not info.get("supports_vision"):
+                continue
+            saw_vision_capable = True
+            if w.is_available():
+                return w, info
+        if saw_vision_capable or saw_unknown:
+            # capability exists (or can't be ruled out); availability is the
+            # transient problem — 503, not a permanent-looking 400
+            raise RouteError(
+                503, "no vision-capable workers available", "service_unavailable"
+            )
+        raise RouteError(
+            400, f"model {model_id or 'default'} does not support image input"
+        )
+
     # ---- core execution with retry (stages 3-6) ----
 
     async def _execute(
@@ -166,8 +213,10 @@ class Router:
         sampling: SamplingParams,
         rid: str,
         tokenizer,
+        mm: tuple | None = None,
     ):
-        """Async generator of StreamEvent with retry-on-dispatch-failure."""
+        """Async generator of StreamEvent with retry-on-dispatch-failure.
+        ``mm`` = (embeds, positions) vision splice riding the dispatch."""
         # stop strings are enforced gateway-side; worker gets token-level params
         worker_sampling = SamplingParams(**{**sampling.__dict__, "stop": []})
         stop_checker = StopStringChecker(sampling.stop) if sampling.stop else None
@@ -178,7 +227,31 @@ class Router:
         )
 
         prefill_pool, decode_pool = self._pd_pools(ctx.model_id)
-        if prefill_pool and decode_pool:
+        mm_exclude: set[str] = set()
+        if mm is not None and prefill_pool and decode_pool:
+            # PD prefill-export doesn't carry the mm splice yet: route image
+            # requests through the regular single-worker path (honest gap;
+            # reference ships mm via the encode->prefill dispatch).  The
+            # bypass must respect disaggregation roles: never run a full
+            # generate on DECODE/ENCODE-typed workers.
+            from smg_tpu.gateway.workers import WorkerType
+
+            typed = [
+                w for w in self._candidate_workers(ctx.model_id)
+                if w.worker_type in (WorkerType.DECODE, WorkerType.ENCODE)
+            ]
+            if len(typed) == len(self._candidate_workers(ctx.model_id)):
+                raise RouteError(
+                    503,
+                    "image input needs a prefill-capable worker; this PD "
+                    "deployment has only decode/encode workers",
+                    "service_unavailable",
+                )
+            mm_exclude = {w.worker_id for w in typed}
+            logger.warning(
+                "request %s has image input; bypassing PD disaggregation", rid
+            )
+        elif prefill_pool and decode_pool:
             async for ev in self._execute_pd(
                 ctx, input_ids, worker_sampling, rid, detok, stop_checker,
                 prefill_pool, decode_pool,
@@ -187,7 +260,7 @@ class Router:
             return
 
         attempts = 0
-        exclude: set[str] = set()
+        exclude: set[str] = set(mm_exclude)
         # dp-rank cost estimate: prompt + generation budget (released on exit)
         dp_cost = len(input_ids) + (worker_sampling.max_new_tokens or 0)
         while True:
@@ -200,6 +273,7 @@ class Router:
                 wreq = WorkerGenerateRequest(
                     rid=rid, input_ids=input_ids, sampling=worker_sampling,
                     data_parallel_rank=-1 if dp_rank is None else dp_rank,
+                    mm_embeds=mm,
                 )
                 async for chunk in worker.client.generate(wreq):
                     got_first_chunk = True
@@ -393,8 +467,116 @@ class Router:
         sampling = req.to_sampling_params(self.config.default_max_tokens)
         return tokenizer, prompt_text, input_ids, sampling
 
+    async def prepare_chat(self, req: ChatCompletionRequest):
+        """Chat preparation including the multimodal encode leg.
+
+        Returns (tokenizer, prompt_text, input_ids, sampling, mm) where mm is
+        None for text-only requests or (embeds [M, E] f32, positions [M]).
+        Image pipeline (reference: EncodeStage, ``stages/encode.rs:1-40`` +
+        the tokenspeed encoder servicer): parse image content parts ->
+        decode -> per-model resize/normalize/patchify -> worker Encode RPC ->
+        grid-expand the placeholder token -> splice positions."""
+        import numpy as np
+
+        from smg_tpu.multimodal.ingest import (
+            ImageIngestError,
+            expand_image_placeholders,
+            extract_image_parts,
+            fetch_image,
+            flatten_content,
+        )
+
+        messages = [m.model_dump(exclude_none=True) for m in req.messages]
+        parts = extract_image_parts(messages)
+        if not parts:
+            return (*self._prepare_chat(req), None)
+
+        tokenizer = self.tokenizers.get(req.model or None)
+        if tokenizer is None:
+            raise RouteError(500, "no tokenizer registered for gateway-side processing")
+        worker, info = await self._vision_worker(req.model or None)
+        image_token_id = int(info.get("image_token_id") or 0)
+        placeholder = tokenizer.decode([image_token_id], skip_special_tokens=False)
+
+        from smg_tpu.multimodal.processor import processor_for_worker
+
+        proc = processor_for_worker(
+            req.model or info.get("model_id") or "",
+            patch_size=info.get("vision_patch_size"),
+            merge_size=info.get("vision_merge_size"),
+        )
+        loop = asyncio.get_running_loop()
+
+        async def one_image(part, session):
+            img = await fetch_image(part, http_session=session)
+            # preprocessing is jax work — keep it off the event loop
+            pimg = await loop.run_in_executor(None, proc.process, img)
+            e = await worker.client.encode_image(
+                np.asarray(pimg.pixel_values, np.float32), pimg.grid
+            )
+            if e.shape[0] != pimg.num_placeholder_tokens:
+                raise RouteError(
+                    502,
+                    f"encode returned {e.shape[0]} embeddings for "
+                    f"{pimg.num_placeholder_tokens} placeholder tokens",
+                    "worker_error",
+                )
+            return np.asarray(e, np.float32), pimg.num_placeholder_tokens
+
+        session = None
+        try:
+            needs_http = any(
+                str((p.get("image_url") or {}).get("url", "")
+                    if isinstance(p.get("image_url"), dict) else p.get("image_url") or "")
+                .startswith(("http://", "https://"))
+                or (p.get("source") or {}).get("type") == "url"
+                for p in parts
+            )
+            if needs_http:
+                import aiohttp
+
+                session = aiohttp.ClientSession()  # one pool for all fetches
+            # fetch -> preprocess -> encode pipelines run concurrently per
+            # image; gather preserves prompt order
+            results = await asyncio.gather(
+                *(one_image(p, session) for p in parts)
+            )
+        except ImageIngestError as e:
+            raise RouteError(400, str(e))
+        except RouteError:
+            raise
+        except Exception as e:
+            logger.exception("image encode failed")
+            raise RouteError(502, f"image encode failed: {e}", "worker_error")
+        finally:
+            if session is not None:
+                await session.close()
+        embeds = [e for e, _ in results]
+        counts = [c for _, c in results]
+
+        flat = flatten_content(messages, placeholder)
+        tools = [t.model_dump(exclude_none=True) for t in req.tools] if req.tools else None
+        try:
+            prompt_text = tokenizer.apply_chat_template(
+                flat, add_generation_prompt=True, tools=tools
+            )
+        except Exception as e:
+            raise RouteError(400, f"chat template failed: {e}")
+        # deliberately uncached encode: mm prompts are dominated by unique
+        # image payloads, not repeated text
+        input_ids = tokenizer.encode(prompt_text)
+        try:
+            input_ids, positions = expand_image_placeholders(
+                input_ids, image_token_id, counts
+            )
+        except ImageIngestError as e:
+            raise RouteError(400, str(e))
+        sampling = req.to_sampling_params(self.config.default_max_tokens)
+        mm = (np.concatenate(embeds, axis=0), np.asarray(positions, np.int64))
+        return tokenizer, prompt_text, input_ids, sampling, mm
+
     async def chat(self, req: ChatCompletionRequest, request_id: str | None = None):
-        tokenizer, prompt_text, input_ids, sampling = self._prepare_chat(req)
+        tokenizer, prompt_text, input_ids, sampling, mm = await self.prepare_chat(req)
         rid = request_id or f"chatcmpl-{uuid.uuid4().hex[:24]}"
         ctx = RequestContext(
             text=prompt_text, token_ids=input_ids,
@@ -406,7 +588,7 @@ class Router:
             last: StreamEvent | None = None
             sub_rid = rid if sampling.n == 1 else f"{rid}-{choice_idx}"
             one_sampling = SamplingParams(**{**sampling.__dict__, "n": 1})
-            async for ev in self._execute(ctx, input_ids, one_sampling, sub_rid, tokenizer):
+            async for ev in self._execute(ctx, input_ids, one_sampling, sub_rid, tokenizer, mm=mm):
                 text_parts.append(ev.text_delta)
                 last = ev
             assert last is not None
@@ -472,7 +654,7 @@ class Router:
 
     async def chat_stream(self, req: ChatCompletionRequest, request_id: str | None = None):
         """Async generator of ChatCompletionStreamChunk."""
-        tokenizer, prompt_text, input_ids, sampling = self._prepare_chat(req)
+        tokenizer, prompt_text, input_ids, sampling, mm = await self.prepare_chat(req)
         rid = request_id or f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
         ctx = RequestContext(
@@ -529,7 +711,7 @@ class Router:
                 return text, reasoning, calls
 
             try:
-                async for ev in self._execute(ctx, input_ids, one_sampling, sub_rid, tokenizer):
+                async for ev in self._execute(ctx, input_ids, one_sampling, sub_rid, tokenizer, mm=mm):
                     text, reasoning, calls = make_delta(ev.text_delta, flush=ev.finished)
                     delta = ChatStreamDelta(
                         role="assistant" if first else None,

@@ -92,14 +92,20 @@ class AppContext:
         # operator loads modules via --plugins; middleware no-ops without it.
         self.plugins = None
 
-    def load_plugins(self, specs, fail_open: bool = True):
-        """Load middleware plugins (file paths or dotted modules)."""
+    def load_plugins(self, specs, fail_open: bool | None = None):
+        """Load middleware plugins (file paths or dotted modules).
+
+        ``fail_open=None`` keeps the existing host's setting — a later call
+        that doesn't state a preference must not silently downgrade a
+        ``--plugin-fail-closed`` gateway to fail-open."""
         from smg_tpu.plugins import PluginHost
 
         if self.plugins is None:
-            self.plugins = PluginHost(fail_open=fail_open)
-        else:
-            # fail-closed is security-relevant: the latest caller's choice
+            self.plugins = PluginHost(
+                fail_open=True if fail_open is None else fail_open
+            )
+        elif fail_open is not None:
+            # fail-closed is security-relevant: an explicit caller choice
             # must win, not be silently dropped on an existing host
             self.plugins.fail_open = fail_open
         for spec in specs:

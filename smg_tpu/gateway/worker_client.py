@@ -27,6 +27,10 @@ class WorkerGenerateRequest:
     # external DP dispatch: pin to one of the worker's engine replicas
     # (-1 = worker chooses; reference sglang_scheduler.proto:157-158)
     data_parallel_rank: int = -1
+    # multimodal splice: (embeds [M, E] float32, positions [M]) — vision
+    # embeddings replacing the image placeholder tokens at ``positions``
+    # (reference: the EPD encode leg's output riding the prefill dispatch)
+    mm_embeds: tuple | None = None
 
 
 @dataclass
@@ -61,6 +65,11 @@ class WorkerClient:
     async def embed(self, batches: list) -> list:
         """batches: list[list[int]] -> list[list[float]]."""
         raise NotImplementedError
+
+    async def encode_image(self, pixel_values, grid: tuple) -> "object":
+        """Vision-tower encode (EPD encode leg): pre-patchified pixels
+        [N, patch_dim] f32 -> np.float32 [N/merge^2, lm_hidden]."""
+        raise NotImplementedError("worker has no vision tower")
 
     async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
         """PD prefill leg: {first_token, k, v, seq_len, connector}."""
@@ -143,7 +152,8 @@ class InProcWorkerClient(WorkerClient):
             loop.call_soon_threadsafe(q.put_nowait, chunk)
 
         self.engine.submit(
-            req.input_ids, req.sampling, rid=req.rid, on_output=on_output
+            req.input_ids, req.sampling, rid=req.rid, on_output=on_output,
+            mm_embeds=req.mm_embeds,
         )
         while True:
             chunk = await q.get()
@@ -160,6 +170,12 @@ class InProcWorkerClient(WorkerClient):
             None, self.engine.embed, [list(b) for b in batches]
         )
         return [v.tolist() for v in vecs]
+
+    async def encode_image(self, pixel_values, grid: tuple) -> "object":
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self.engine.encode_image(pixel_values, grid)
+        )
 
     async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
         loop = asyncio.get_running_loop()
@@ -209,12 +225,21 @@ class InProcWorkerClient(WorkerClient):
 
     async def get_model_info(self) -> dict:
         cfg = self.engine.config
-        return {
+        info = {
             "model_id": cfg.model_id,
             "max_seq_len": cfg.scheduler.max_seq_len,
             "vocab_size": cfg.model.vocab_size,
             "eos_token_ids": list(cfg.model.eos_token_ids),
+            "page_size": cfg.cache.page_size,
+            "supports_vision": self.engine.supports_vision,
         }
+        if self.engine.supports_vision:
+            info.update(
+                image_token_id=cfg.model.image_token_id,
+                vision_patch_size=cfg.model.vision.patch_size,
+                vision_merge_size=cfg.model.vision.merge_size,
+            )
+        return info
 
     async def flush_cache(self) -> bool:
         return self.engine.flush_cache()

@@ -71,23 +71,108 @@ def _transform(path: tuple[str, ...], w: np.ndarray, cfg) -> np.ndarray:
     return w  # embed [V, E], norms [E]
 
 
-def load_params(engine_cfg, mesh=None, rules=None):
-    """Load params for ``engine_cfg.model`` from ``engine_cfg.model_path``."""
+def _open_checkpoint(path: str):
+    """(handles, name->handle-index map) over all *.safetensors in ``path``."""
     from safetensors import safe_open
 
-    cfg = engine_cfg.model
-    path = engine_cfg.model_path
-    dtype = jnp.dtype(engine_cfg.dtype)
     files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no safetensors under {path}")
-
-    # tensor name -> file handle index
     location: dict[str, int] = {}
     handles = [safe_open(f, framework="numpy") for f in files]
     for i, h in enumerate(handles):
         for name in h.keys():
             location[name] = i
+    return handles, location
+
+
+def load_vision_params(engine_cfg):
+    """Load the vision-tower pytree from a Qwen2-VL-style HF checkpoint
+    (``visual.*`` keys) via ``models.vit.HF_VISION_MAPPING``.
+
+    Layout transforms:
+    - patch-embed conv ``[H, C, (T,) ps, ps]`` -> ``[patch_dim, H]``.  A
+      temporal dim (Qwen2-VL Conv3d, T=2 frames) is collapsed by summing —
+      for a single image the checkpoint's temporal patch is the same frame
+      repeated, and conv over a repeated frame equals the summed-kernel conv.
+      Element order becomes (ps, ps, C) to match ``multimodal.image.patchify``
+      (which flattens [gh, gw, ps, ps, C] row-major).
+    - linear ``[out, in]`` -> ``[in, out]`` (our right-multiply layout);
+    - layer norms map to {scale, bias} from ``.weight``/``.bias``.
+    Returns a pytree matching ``vit.init_vision_params`` structure.
+    """
+    import jax.numpy as jnp
+
+    from smg_tpu.models.vit import HF_VISION_MAPPING
+
+    cfg = engine_cfg.model
+    vcfg = cfg.vision
+    if vcfg is None:
+        raise ValueError("model config has no vision tower")
+    dtype = jnp.dtype(vcfg.dtype)
+    handles, location = _open_checkpoint(engine_cfg.model_path)
+
+    def fetch(name: str) -> np.ndarray:
+        if name not in location:
+            raise KeyError(f"tensor {name} not found in checkpoint")
+        return handles[location[name]].get_tensor(name)
+
+    def conv_to_matrix(w: np.ndarray) -> np.ndarray:
+        if w.ndim == 5:  # [H, C, T, ps, ps] Conv3d: collapse temporal by sum
+            w = w.sum(axis=2)
+        H, C, ph, pw = w.shape
+        # (ps, ps, C) element order to match patchify's flatten
+        return w.transpose(2, 3, 1, 0).reshape(ph * pw * C, H)
+
+    def linear(w: np.ndarray) -> np.ndarray:
+        return w.transpose(1, 0)
+
+    def norm(prefix: str) -> dict:
+        return {
+            "scale": jnp.asarray(fetch(prefix + ".weight"), dtype),
+            "bias": jnp.asarray(fetch(prefix + ".bias"), dtype),
+        }
+
+    layers: list[dict] = []
+    for i in range(vcfg.num_layers):
+        layers.append({
+            "ln1": norm(HF_VISION_MAPPING["layers.{i}.ln1"].format(i=i)),
+            "qkv_w": linear(fetch(HF_VISION_MAPPING["layers.{i}.qkv_w"].format(i=i))),
+            "qkv_b": fetch(HF_VISION_MAPPING["layers.{i}.qkv_b"].format(i=i)),
+            "proj_w": linear(fetch(HF_VISION_MAPPING["layers.{i}.proj_w"].format(i=i))),
+            "proj_b": fetch(HF_VISION_MAPPING["layers.{i}.proj_b"].format(i=i)),
+            "ln2": norm(HF_VISION_MAPPING["layers.{i}.ln2"].format(i=i)),
+            "fc1_w": linear(fetch(HF_VISION_MAPPING["layers.{i}.fc1_w"].format(i=i))),
+            "fc1_b": fetch(HF_VISION_MAPPING["layers.{i}.fc1_b"].format(i=i)),
+            "fc2_w": linear(fetch(HF_VISION_MAPPING["layers.{i}.fc2_w"].format(i=i))),
+            "fc2_b": fetch(HF_VISION_MAPPING["layers.{i}.fc2_b"].format(i=i)),
+        })
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x, dtype) for x in xs]), *layers
+    )
+    params = {
+        "patch_embed": jnp.asarray(
+            conv_to_matrix(fetch(HF_VISION_MAPPING["patch_embed"])), dtype
+        ),
+        "layers": stacked,
+        "merger": {
+            "ln_q": norm(HF_VISION_MAPPING["merger.ln_q"]),
+            "mlp0_w": jnp.asarray(linear(fetch(HF_VISION_MAPPING["merger.mlp0_w"])), dtype),
+            "mlp0_b": jnp.asarray(fetch(HF_VISION_MAPPING["merger.mlp0_b"]), dtype),
+            "mlp2_w": jnp.asarray(linear(fetch(HF_VISION_MAPPING["merger.mlp2_w"])), dtype),
+            "mlp2_b": jnp.asarray(fetch(HF_VISION_MAPPING["merger.mlp2_b"]), dtype),
+        },
+    }
+    logger.info("loaded vision tower: %d layers, patch_embed %s",
+                vcfg.num_layers, params["patch_embed"].shape)
+    return params
+
+
+def load_params(engine_cfg, mesh=None, rules=None):
+    """Load params for ``engine_cfg.model`` from ``engine_cfg.model_path``."""
+    cfg = engine_cfg.model
+    dtype = jnp.dtype(engine_cfg.dtype)
+    handles, location = _open_checkpoint(engine_cfg.model_path)
 
     shardings = None
     if mesh is not None:

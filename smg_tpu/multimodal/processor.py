@@ -102,3 +102,26 @@ def get_image_processor(name_or_model: str) -> ImageProcessor:
         if sub in key:
             return _PROCESSORS[name]()
     return LlavaImageProcessor()
+
+
+def processor_for_worker(
+    name_or_model: str,
+    patch_size: int | None = None,
+    merge_size: int | None = None,
+) -> ImageProcessor:
+    """Processor matched to a worker's advertised vision tower (ModelInfo
+    vision fields): family by model name, geometry from the worker so the
+    gateway's patchify always agrees with the tower's patch embedding.
+    Unknown families default to the smart-resize (Qwen2-VL-style) processor —
+    the general dynamic-resolution mechanism."""
+    key = (name_or_model or "").lower()
+    family = None
+    for sub, name in _MODEL_MAP:
+        if sub in key:
+            family = name
+            break
+    if family == "llava":
+        return LlavaImageProcessor(patch_size=patch_size or 14)
+    return Qwen2VLImageProcessor(
+        patch_size=patch_size or 14, merge_size=merge_size or 2
+    )

@@ -61,6 +61,8 @@ class AnthropicMessagesRequest(BaseModel):
                 out.append({"role": m.role, "content": m.content})
                 continue
             text_parts: list[str] = []
+            parts: list[dict] = []  # ordered text+image parts (mm path)
+            has_image = False
             tool_calls: list[dict] = []
             tool_results: list[dict] = []
             for b in m.content:
@@ -69,6 +71,12 @@ class AnthropicMessagesRequest(BaseModel):
                 btype = b.get("type")
                 if btype == "text":
                     text_parts.append(b.get("text", ""))
+                    parts.append(b)
+                elif btype == "image":
+                    # preserved as a content part: the router's multimodal
+                    # ingest consumes Anthropic source blocks directly
+                    has_image = True
+                    parts.append(b)
                 elif btype == "tool_use":
                     tool_calls.append(
                         {
@@ -99,6 +107,8 @@ class AnthropicMessagesRequest(BaseModel):
                 out.append(
                     {"role": "assistant", "content": text or None, "tool_calls": tool_calls}
                 )
+            elif has_image:
+                out.append({"role": m.role, "content": parts})
             elif text or not tool_results:
                 out.append({"role": m.role, "content": text})
             out.extend(tool_results)

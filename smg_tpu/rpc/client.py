@@ -19,7 +19,11 @@ from smg_tpu.gateway.worker_client import (
 )
 from smg_tpu.rpc import method
 from smg_tpu.rpc import scheduler_pb2 as pb
-from smg_tpu.rpc.convert import kv_batch_from_proto, sampling_to_proto
+from smg_tpu.rpc.convert import (
+    kv_batch_from_proto,
+    mm_embeds_to_proto,
+    sampling_to_proto,
+)
 from smg_tpu.utils import get_logger
 
 logger = get_logger("rpc.client")
@@ -53,6 +57,11 @@ class GrpcWorkerClient(WorkerClient):
             method("EmbedBatch"),
             request_serializer=pb.EmbedBatchRequestProto.SerializeToString,
             response_deserializer=pb.EmbedBatchResponseProto.FromString,
+        )
+        self._encode = c.unary_unary(
+            method("Encode"),
+            request_serializer=pb.EncodeRequestProto.SerializeToString,
+            response_deserializer=pb.EncodeResponseProto.FromString,
         )
         self._prefill_export = c.unary_unary(
             method("PrefillExport"),
@@ -132,6 +141,9 @@ class GrpcWorkerClient(WorkerClient):
             sampling=sampling_to_proto(req.sampling),
             data_parallel_rank=req.data_parallel_rank,
         )
+        mm = mm_embeds_to_proto(getattr(req, "mm_embeds", None))
+        if mm is not None:
+            msg.mm_embeds.CopyFrom(mm)
         call = self._generate(msg)
         try:
             async for chunk in call:
@@ -221,6 +233,25 @@ class GrpcWorkerClient(WorkerClient):
             raise RuntimeError(f"worker embed error: {resp.error}")
         return [list(v.values) for v in resp.embeddings]
 
+    async def encode_image(self, pixel_values, grid: tuple) -> "object":
+        import numpy as np
+
+        pixels = np.ascontiguousarray(np.asarray(pixel_values, np.float32))
+        resp = await self._encode(
+            pb.EncodeRequestProto(
+                rid="encode",
+                pixel_values=pixels.tobytes(),
+                n_patches=pixels.shape[0], patch_dim=pixels.shape[1],
+                grid_h=int(grid[0]), grid_w=int(grid[1]),
+            ),
+            timeout=300,
+        )
+        if resp.error:
+            raise RuntimeError(f"worker encode error: {resp.error}")
+        return np.frombuffer(resp.embeds, dtype=np.float32).reshape(
+            resp.rows, resp.cols
+        )
+
     async def abort(self, rid: str) -> bool:
         try:
             resp = await self._abort(pb.AbortRequestProto(rid=rid), timeout=5)
@@ -248,14 +279,22 @@ class GrpcWorkerClient(WorkerClient):
 
     async def get_model_info(self) -> dict:
         resp = await self._model_info(pb.EmptyProto(), timeout=10)
-        return {
+        info = {
             "model_id": resp.model_id,
             "max_seq_len": resp.max_seq_len,
             "vocab_size": resp.vocab_size,
             "eos_token_ids": list(resp.eos_token_ids),
             "page_size": resp.page_size,
             "dp_size": resp.dp_size or 1,
+            "supports_vision": resp.supports_vision,
         }
+        if resp.supports_vision:
+            info.update(
+                image_token_id=resp.image_token_id,
+                vision_patch_size=resp.vision_patch_size,
+                vision_merge_size=resp.vision_merge_size,
+            )
+        return info
 
     async def flush_cache(self) -> bool:
         resp = await self._flush(pb.EmptyProto(), timeout=30)

@@ -67,6 +67,39 @@ def sampling_from_proto(msg: pb.SamplingParamsProto) -> SamplingParams:
     )
 
 
+def mm_embeds_to_proto(mm: "tuple | None") -> pb.MmEmbedsProto | None:
+    """(embeds [M, E] f32, positions [M]) -> MmEmbedsProto (None passes
+    through).  Rows > 0 signals presence on the wire (proto3 has no
+    has-field for messages constructed empty)."""
+    if mm is None:
+        return None
+    import numpy as np
+
+    embeds, positions = mm
+    embeds = np.ascontiguousarray(np.asarray(embeds, np.float32))
+    if embeds.ndim != 2:
+        raise ValueError(f"mm embeds must be [rows, cols], got {embeds.shape}")
+    return pb.MmEmbedsProto(
+        embeds=embeds.tobytes(),
+        rows=embeds.shape[0],
+        cols=embeds.shape[1],
+        positions=[int(p) for p in positions],
+    )
+
+
+def mm_embeds_from_proto(msg: pb.MmEmbedsProto) -> "tuple | None":
+    """MmEmbedsProto -> (embeds [M, E] f32, positions [M]) or None when the
+    field was absent/empty (rows == 0)."""
+    if msg is None or msg.rows == 0:
+        return None
+    import numpy as np
+
+    embeds = np.frombuffer(msg.embeds, dtype=np.float32).reshape(
+        msg.rows, msg.cols
+    )
+    return embeds, np.asarray(list(msg.positions), np.int64)
+
+
 def kv_batch_to_proto(batch: KvEventBatch) -> pb.KvEventBatchProto:
     msg = pb.KvEventBatchProto(
         sequence_number=batch.sequence_number, dp_rank=batch.dp_rank

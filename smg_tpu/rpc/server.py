@@ -19,7 +19,11 @@ import grpc.aio
 
 from smg_tpu.rpc import SERVICE
 from smg_tpu.rpc import scheduler_pb2 as pb
-from smg_tpu.rpc.convert import kv_batch_to_proto, sampling_from_proto
+from smg_tpu.rpc.convert import (
+    kv_batch_to_proto,
+    mm_embeds_from_proto,
+    sampling_from_proto,
+)
 from smg_tpu.utils import get_logger
 
 logger = get_logger("rpc.server")
@@ -68,6 +72,7 @@ class SchedulerServicer:
             engine.submit(
                 list(request.input_ids), sampling, rid=rid,
                 on_output=on_output, priority=request.priority,
+                mm_embeds=mm_embeds_from_proto(request.mm_embeds),
             )
         except ValueError as e:
             # invalid sampling config (e.g. unsupported regex/ebnf constraint):
@@ -127,6 +132,28 @@ class SchedulerServicer:
         except Exception as e:
             logger.exception("embed batch failed")
             return pb.EmbedBatchResponseProto(error=str(e))
+
+    async def Encode(self, request: pb.EncodeRequestProto, context):
+        """EPD encode leg: vision-tower forward on pre-patchified pixels
+        (reference: the tokenspeed encoder servicer's Encode RPC)."""
+        import numpy as np
+
+        loop = asyncio.get_running_loop()
+        try:
+            pixels = np.frombuffer(
+                request.pixel_values, dtype=np.float32
+            ).reshape(request.n_patches, request.patch_dim)
+            grid = (request.grid_h, request.grid_w)
+            out = await loop.run_in_executor(
+                None, lambda: self.engine.encode_image(pixels, grid)
+            )
+            return pb.EncodeResponseProto(
+                embeds=np.ascontiguousarray(out, np.float32).tobytes(),
+                rows=out.shape[0], cols=out.shape[1],
+            )
+        except Exception as e:
+            logger.exception("encode failed")
+            return pb.EncodeResponseProto(error=str(e))
 
     async def PrefillExport(self, request: pb.PrefillExportRequestProto, context):
         import numpy as np
@@ -211,7 +238,7 @@ class SchedulerServicer:
 
     async def GetModelInfo(self, request: pb.EmptyProto, context):
         cfg = self.engine.config
-        return pb.ModelInfoProto(
+        msg = pb.ModelInfoProto(
             model_id=cfg.model_id,
             max_seq_len=cfg.scheduler.max_seq_len,
             vocab_size=cfg.model.vocab_size,
@@ -219,6 +246,12 @@ class SchedulerServicer:
             page_size=cfg.cache.page_size,
             dp_size=len(self.engines),
         )
+        if self.engine.supports_vision:
+            msg.supports_vision = True
+            msg.image_token_id = cfg.model.image_token_id or 0
+            msg.vision_patch_size = cfg.model.vision.patch_size
+            msg.vision_merge_size = cfg.model.vision.merge_size
+        return msg
 
     async def FlushCache(self, request: pb.EmptyProto, context):
         return pb.FlushResponseProto(ok=all(e.flush_cache() for e in self.engines))
@@ -326,6 +359,11 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             servicer.Embed,
             request_deserializer=pb.EmbedRequestProto.FromString,
             response_serializer=pb.EmbedResponseProto.SerializeToString,
+        ),
+        "Encode": grpc.unary_unary_rpc_method_handler(
+            servicer.Encode,
+            request_deserializer=pb.EncodeRequestProto.FromString,
+            response_serializer=pb.EncodeResponseProto.SerializeToString,
         ),
         "PrefillExport": grpc.unary_unary_rpc_method_handler(
             servicer.PrefillExport,

@@ -155,6 +155,21 @@ def test_drain_before_remove():
     loop, ctx, tc, run = _gateway([w0, w1])
     try:
         async def go():
+            # Prewarm both engines first (pin selection via draining) so the
+            # drain window below measures scheduling, not first-compile time —
+            # under full-suite CPU load compiles can take minutes and the
+            # 600×0.05s engagement poll would time out (r3 flake).
+            for warm, other in ((w0, w1), (w1, w0)):
+                other.draining = True
+                r = await tc.post("/v1/chat/completions", json={
+                    "model": "tiny-test",
+                    "messages": [{"role": "user", "content": "w1 w2"}],
+                    "max_tokens": 2, "temperature": 0, "ignore_eos": True,
+                })
+                assert r.status == 200
+                other.draining = False
+            w0.total_requests = w1.total_requests = 0
+
             # occupy w0 with a slow stream — pin selection by draining w1
             # for the setup call (deterministic; the old round_robin hunt
             # raced with selection state left by earlier tests)
@@ -193,7 +208,7 @@ def test_drain_before_remove():
             del_body = await del_resp.json()
             return raw, del_body
 
-        raw, del_body = run(go())
+        raw, del_body = run(go(), timeout=420)
         frames = [l for l in raw.splitlines() if l.startswith("data: ")]
         assert frames[-1] == "data: [DONE]"  # the in-flight stream finished
         assert len([f for f in frames if "choices" in f]) >= 10

@@ -91,7 +91,10 @@ def test_sp_serving_prefill_matches_single(cpu_devices):
     runner = sp4.runner
     res = sp4.generate(prompt_ids=prompt, sampling=sampling)
     assert res.token_ids == ref.token_ids
-    # the ring variant actually compiled (cold chunk T=64 % sp=4 == 0)
-    assert any(k[0] == "prefill" and k[-1] for k in runner._compiled), (
+    # the ring variant actually compiled (cold chunk T=64 % sp=4 == 0).
+    # Prefill compile keys are ("prefill", T, mp, impl, use_pen, use_mask,
+    # use_lora, use_ring, ...): match use_ring by position, not k[-1], so
+    # appending new flags to the key doesn't break this assertion.
+    assert any(k[0] == "prefill" and k[7] for k in runner._compiled), (
         "expected a use_ring=True prefill variant to be compiled"
     )

@@ -1,0 +1,480 @@
+"""Multimodal SERVING path e2e (VERDICT r3 #1): image content parts through
+the HTTP gateway -> encode leg -> mm splice -> generation, over real gRPC.
+
+Reference parity: the EncodeStage + encoder servicer + prefill splice
+(``model_gateway/src/routers/grpc/common/stages/encode.rs:1-40``,
+``grpc_servicer/smg_grpc_servicer/tokenspeed/encoder_servicer.py``)."""
+
+import asyncio
+import base64
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import tiny_vlm_config
+from smg_tpu.multimodal.ingest import (
+    ImageIngestError,
+    expand_image_placeholders,
+    extract_image_parts,
+    flatten_content,
+)
+from smg_tpu.multimodal.processor import processor_for_worker
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def _vlm_engine() -> Engine:
+    cfg = EngineConfig(
+        model=tiny_vlm_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(32, 64), decode_batch_buckets=(2, 4),
+        ),
+        dtype="float32",
+        model_id="tiny-vlm",
+    )
+    return Engine(cfg, tokenizer=MockTokenizer())
+
+
+def _png_data_uri(rng, h=24, w=16) -> str:
+    from PIL import Image
+
+    arr = rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr, "RGB").save(buf, format="PNG")
+    return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+
+# ---- ingest unit tests ----
+
+
+def test_extract_and_flatten_content():
+    messages = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": [
+            {"type": "text", "text": "w5"},
+            {"type": "image_url", "image_url": {"url": "data:,x"}},
+            {"type": "text", "text": "w6"},
+        ]},
+    ]
+    parts = extract_image_parts(messages)
+    assert len(parts) == 1
+    flat = flatten_content(messages, "w500")
+    assert flat[0]["content"] == "be brief"
+    assert flat[1]["content"] == "w5 w500 w6"
+    # original untouched
+    assert isinstance(messages[1]["content"], list)
+
+
+def test_expand_image_placeholders():
+    ids, pos = expand_image_placeholders([1, 500, 2, 500, 3], 500, [2, 3])
+    assert ids == [1, 500, 500, 2, 500, 500, 500, 3]
+    assert pos == [1, 2, 4, 5, 6]
+    with pytest.raises(ImageIngestError):
+        expand_image_placeholders([1, 500, 2], 500, [2, 3])  # count mismatch
+
+
+def test_fetch_image_data_uri():
+    from smg_tpu.multimodal.ingest import fetch_image
+
+    async def go():
+        rng = np.random.default_rng(0)
+        uri = _png_data_uri(rng, 8, 6)
+        arr = await fetch_image({"type": "image_url", "image_url": {"url": uri}})
+        assert arr.shape == (8, 6, 3) and arr.dtype == np.uint8
+        # Anthropic-style base64 source block
+        raw = base64.b64decode(uri.split(",", 1)[1])
+        arr2 = await fetch_image({
+            "type": "image", "source": {"type": "base64",
+                                        "data": base64.b64encode(raw).decode()},
+        })
+        np.testing.assert_array_equal(arr, arr2)
+        with pytest.raises(ImageIngestError):
+            await fetch_image({"type": "image_url", "image_url": {"url": "!!!"}})
+
+    asyncio.run(go())
+
+
+def test_mm_proto_roundtrip():
+    from smg_tpu.rpc.convert import mm_embeds_from_proto, mm_embeds_to_proto
+
+    rng = np.random.default_rng(1)
+    embeds = rng.standard_normal((5, 16)).astype(np.float32)
+    positions = np.asarray([3, 4, 5, 6, 7])
+    msg = mm_embeds_to_proto((embeds, positions))
+    back = mm_embeds_from_proto(msg)
+    np.testing.assert_array_equal(back[0], embeds)
+    np.testing.assert_array_equal(back[1], positions)
+    assert mm_embeds_to_proto(None) is None
+    assert mm_embeds_from_proto(None) is None
+
+
+# ---- e2e: HTTP gateway -> gRPC worker -> encode + mm generate ----
+
+
+@pytest.fixture(scope="module")
+def vlm_stack():
+    """Gateway (aiohttp TestClient) over a real gRPC VLM worker."""
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.workers import Worker
+    from smg_tpu.rpc.client import GrpcWorkerClient
+    from smg_tpu.rpc.server import serve_worker_async
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=300):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    engine = _vlm_engine()
+    engine.start()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-vlm", MockTokenizer(), default=True)
+
+    async def _setup():
+        server = await serve_worker_async(engine, port=0, host="127.0.0.1")
+        client = GrpcWorkerClient(f"127.0.0.1:{server._bound_port}")
+        ctx.registry.add(Worker(worker_id="vlm0", client=client, model_id="tiny-vlm"))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return server, client, tc
+
+    server, client, tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.engine, h.tc, h.ctx = run, engine, tc, ctx
+    yield h
+    run(tc.close())
+    run(client.close())
+    run(server.stop(grace=None))
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+def _expected_ids(engine, messages, uri_arrays, max_new=8):
+    """Mirror the gateway pipeline engine-side for a parity target."""
+    tok = MockTokenizer()
+    info_patch = engine.config.model.vision.patch_size
+    info_merge = engine.config.model.vision.merge_size
+    pad = engine.config.model.image_token_id
+    proc = processor_for_worker("tiny-vlm", patch_size=info_patch,
+                                merge_size=info_merge)
+    embeds, counts = [], []
+    for arr in uri_arrays:
+        p = proc.process(arr)
+        e = engine.encode_image(np.asarray(p.pixel_values, np.float32), p.grid)
+        assert e.shape[0] == p.num_placeholder_tokens
+        embeds.append(e)
+        counts.append(p.num_placeholder_tokens)
+    flat = flatten_content(messages, tok.decode([pad]))
+    prompt = tok.apply_chat_template(flat, add_generation_prompt=True)
+    ids = tok.encode(prompt)
+    ids, positions = expand_image_placeholders(ids, pad, counts)
+    # direct submit with mm (engine.generate has no mm param)
+    done = threading.Event()
+    acc = []
+
+    def cb(out):
+        acc.extend(out.new_token_ids)
+        if out.finished:
+            done.set()
+
+    engine.submit(ids, SamplingParams(temperature=0.0, max_new_tokens=max_new,
+                                      ignore_eos=True),
+                  rid="parity-target", on_output=cb,
+                  mm_embeds=(np.concatenate(embeds), positions))
+    assert done.wait(timeout=300)
+    return list(acc)
+
+
+def test_image_chat_e2e_over_grpc(vlm_stack):
+    """An image chat request completes through the HTTP gateway against a
+    VLM worker over real gRPC, and matches the engine-direct mm path
+    token-for-token (the VERDICT r3 'done' condition)."""
+    h = vlm_stack
+    rng = np.random.default_rng(7)
+    uri = _png_data_uri(rng)
+    messages = [{"role": "user", "content": [
+        {"type": "text", "text": "w5"},
+        {"type": "image_url", "image_url": {"url": uri}},
+        {"type": "text", "text": "w6"},
+    ]}]
+
+    async def go():
+        r = await h.tc.post("/v1/chat/completions", json={
+            "model": "tiny-vlm", "messages": messages,
+            "max_tokens": 8, "temperature": 0, "ignore_eos": True,
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    text = body["choices"][0]["message"]["content"]
+    assert text
+
+    # parity: identical pipeline engine-side
+    from smg_tpu.multimodal.ingest import fetch_image
+
+    arr = h.run(fetch_image(messages[0]["content"][1]))
+    want_ids = _expected_ids(h.engine, messages, [arr])
+    want_text = MockTokenizer().decode(want_ids)
+    assert text == want_text
+    # placeholder expansion grew the prompt beyond the raw words
+    assert body["usage"]["prompt_tokens"] > 10
+
+
+def test_image_chat_streaming(vlm_stack):
+    h = vlm_stack
+    rng = np.random.default_rng(9)
+    uri = _png_data_uri(rng, 16, 16)
+
+    async def go():
+        r = await h.tc.post("/v1/chat/completions", json={
+            "model": "tiny-vlm",
+            "messages": [{"role": "user", "content": [
+                {"type": "image_url", "image_url": {"url": uri}},
+                {"type": "text", "text": "w9"},
+            ]}],
+            "max_tokens": 6, "temperature": 0, "ignore_eos": True,
+            "stream": True,
+        })
+        return r.status, await r.text()
+
+    status, raw = h.run(go())
+    assert status == 200
+    frames = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    parsed = [json.loads(f) for f in frames if f != "[DONE]"]
+    out = "".join(
+        p["choices"][0]["delta"].get("content") or "" for p in parsed if p["choices"]
+    )
+    assert out.strip()
+
+
+def test_anthropic_image_message_e2e(vlm_stack):
+    """Anthropic Messages surface: base64 image source blocks reach the
+    same encode leg (reference: multi-surface mm parity)."""
+    h = vlm_stack
+    rng = np.random.default_rng(11)
+    uri = _png_data_uri(rng, 16, 24)
+    b64 = uri.split(",", 1)[1]
+
+    async def go():
+        r = await h.tc.post("/v1/messages", json={
+            "model": "tiny-vlm", "max_tokens": 6,
+            "messages": [{"role": "user", "content": [
+                {"type": "image", "source": {
+                    "type": "base64", "media_type": "image/png", "data": b64}},
+                {"type": "text", "text": "w5"},
+            ]}],
+            "temperature": 0,
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    blocks = body.get("content") or []
+    assert any(b.get("type") == "text" and b.get("text") for b in blocks), body
+
+
+def test_image_chat_bad_payload_400(vlm_stack):
+    h = vlm_stack
+
+    async def go():
+        r = await h.tc.post("/v1/chat/completions", json={
+            "model": "tiny-vlm",
+            "messages": [{"role": "user", "content": [
+                {"type": "image_url", "image_url": {"url": "data:image/png;base64,%%%"}},
+            ]}],
+            "max_tokens": 4,
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 400
+    assert "base64" in json.dumps(body)
+
+
+def test_text_only_model_rejects_images():
+    """A text-only deployment answers 400 (not 500) to image content."""
+    from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.worker_client import InProcWorkerClient
+    from smg_tpu.gateway.workers import Worker
+    from smg_tpu.models.config import tiny_test_config
+
+    eng = Engine(EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=2, max_seq_len=128, max_prefill_tokens=32,
+            prefill_token_buckets=(32,), decode_batch_buckets=(2,),
+        ),
+        dtype="float32", model_id="text-only",
+    ), tokenizer=MockTokenizer())
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=120):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("text-only", MockTokenizer(), default=True)
+
+    async def _setup():
+        ctx.registry.add(Worker(worker_id="w0", client=InProcWorkerClient(eng),
+                                model_id="text-only"))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    tc = run(_setup())
+    try:
+        async def go():
+            r = await tc.post("/v1/chat/completions", json={
+                "model": "text-only",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "w5"},
+                    {"type": "image_url", "image_url": {"url": "data:,x"}},
+                ]}],
+                "max_tokens": 4,
+            })
+            return r.status, await r.json()
+
+        status, body = run(go())
+        assert status == 400
+        assert "image" in json.dumps(body).lower()
+    finally:
+        run(tc.close())
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+
+
+# ---- vision weight loading (HF checkpoint -> tower pytree) ----
+
+
+def _fake_vision_checkpoint(tmp_path, vcfg, out_hidden, conv3d=False):
+    """Random Qwen2-VL-style ``visual.*`` safetensors checkpoint."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    H, I = vcfg.hidden_size, vcfg.intermediate_size
+    m2 = vcfg.merge_size**2
+    ps, C = vcfg.patch_size, vcfg.in_channels
+
+    def r(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    tensors = {}
+    if conv3d:
+        tensors["visual.patch_embed.proj.weight"] = r(H, C, 2, ps, ps)
+    else:
+        tensors["visual.patch_embed.proj.weight"] = r(H, C, ps, ps)
+    for i in range(vcfg.num_layers):
+        p = f"visual.blocks.{i}"
+        tensors[f"{p}.norm1.weight"] = r(H) + 1.0
+        tensors[f"{p}.norm1.bias"] = r(H)
+        tensors[f"{p}.attn.qkv.weight"] = r(3 * H, H)
+        tensors[f"{p}.attn.qkv.bias"] = r(3 * H)
+        tensors[f"{p}.attn.proj.weight"] = r(H, H)
+        tensors[f"{p}.attn.proj.bias"] = r(H)
+        tensors[f"{p}.norm2.weight"] = r(H) + 1.0
+        tensors[f"{p}.norm2.bias"] = r(H)
+        tensors[f"{p}.mlp.fc1.weight"] = r(I, H)
+        tensors[f"{p}.mlp.fc1.bias"] = r(I)
+        tensors[f"{p}.mlp.fc2.weight"] = r(H, I)
+        tensors[f"{p}.mlp.fc2.bias"] = r(H)
+    tensors["visual.merger.ln_q.weight"] = r(H) + 1.0
+    tensors["visual.merger.ln_q.bias"] = r(H)
+    tensors["visual.merger.mlp.0.weight"] = r(H * m2, H * m2)
+    tensors["visual.merger.mlp.0.bias"] = r(H * m2)
+    tensors["visual.merger.mlp.2.weight"] = r(out_hidden, H * m2)
+    tensors["visual.merger.mlp.2.bias"] = r(out_hidden)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    return tensors
+
+
+@pytest.mark.parametrize("conv3d", [False, True])
+def test_load_vision_params_conv_order(tmp_path, conv3d):
+    """The conv->matrix flatten must agree with torch's conv semantics in
+    patchify's (ps, ps, C) element order — checked against F.conv2d/conv3d
+    as an independent oracle."""
+    import torch
+    import torch.nn.functional as F
+
+    from smg_tpu.models.weights import load_vision_params
+    from smg_tpu.multimodal.image import patchify
+
+    cfg = _vlm_engine().config  # tiny vlm (engine unused further)
+    vcfg = cfg.model.vision
+    tensors = _fake_vision_checkpoint(
+        tmp_path, vcfg, cfg.model.hidden_size, conv3d=conv3d
+    )
+    import dataclasses
+
+    ecfg = dataclasses.replace(cfg, model_path=str(tmp_path))
+    params = load_vision_params(ecfg)
+    assert params["patch_embed"].shape == (vcfg.patch_dim, vcfg.hidden_size)
+
+    ps, C = vcfg.patch_size, vcfg.in_channels
+    rng = np.random.default_rng(3)
+    img = rng.standard_normal((2 * ps, 3 * ps, C)).astype(np.float32)
+    patches, grid = patchify(img, ps)
+    ours = np.asarray(patches, np.float32) @ np.asarray(params["patch_embed"])
+
+    w = torch.from_numpy(tensors["visual.patch_embed.proj.weight"])
+    ti = torch.from_numpy(img).permute(2, 0, 1)[None]  # [1, C, H, W]
+    if conv3d:
+        ti = ti.unsqueeze(2).repeat(1, 1, 2, 1, 1)  # duplicated frame
+        out = F.conv3d(ti, w, stride=(2, ps, ps))[0, :, 0]  # [H, gh, gw]
+    else:
+        out = F.conv2d(ti, w, stride=ps)[0]  # [H, gh, gw]
+    theirs = out.permute(1, 2, 0).reshape(-1, vcfg.hidden_size).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+
+def test_engine_uses_loaded_vision_params(tmp_path):
+    """Engine(vision_params=...) serves the loaded tower, not random init."""
+    import dataclasses
+
+    from smg_tpu.models.weights import load_vision_params
+
+    base = _vlm_engine()
+    try:
+        vcfg = base.config.model.vision
+        _fake_vision_checkpoint(tmp_path, vcfg, base.config.model.hidden_size)
+        ecfg = dataclasses.replace(base.config, model_path=str(tmp_path))
+        vp = load_vision_params(ecfg)
+        eng = Engine(base.config, tokenizer=MockTokenizer(), vision_params=vp)
+        try:
+            gh = gw = 4
+            rng = np.random.default_rng(5)
+            pixels = rng.standard_normal((gh * gw, vcfg.patch_dim)).astype(np.float32)
+            out = eng.encode_image(pixels, (gh, gw))
+            from smg_tpu.models.vit import forward_vision
+
+            want = np.asarray(
+                forward_vision(vp, vcfg, pixels, (gh, gw)), np.float32
+            )
+            np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+            # differs from the random-init tower
+            rand = base.encode_image(pixels, (gh, gw))
+            assert not np.allclose(out, rand)
+        finally:
+            eng.stop()
+    finally:
+        base.stop()
