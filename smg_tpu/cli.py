@@ -44,6 +44,14 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
                    help="routing policy (round_robin, random, cache_aware, least_load, "
                         "power_of_two, prefix_hash, consistent_hashing, manual, bucket)")
     g.add_argument("--max-concurrent-requests", type=int, default=256)
+    g.add_argument("--storage", default=None,
+                   help="conversation storage backend: memory (default), "
+                        "sqlite:PATH, redis://..., postgres://...")
+    g.add_argument("--otel-endpoint", default=None, dest="otel_endpoint",
+                   help="OTLP/HTTP collector base URL (e.g. "
+                        "http://127.0.0.1:4318); enables trace export")
+    g.add_argument("--otel-service-name", default="smg-tpu",
+                   dest="otel_service_name")
     g.add_argument("--kv-connector", default="auto", choices=["auto", "host", "device"],
                    help="PD KV handoff: device-to-device jax transfer or host bytes")
     g.add_argument("--provider-config", default=None,

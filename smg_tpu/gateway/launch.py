@@ -122,6 +122,9 @@ async def _run_gateway(args) -> int:
             kv_connector=getattr(args, "kv_connector", "auto")
         ),
         max_concurrent_requests=args.max_concurrent_requests,
+        storage=getattr(args, "storage", None),
+        otel_endpoint=getattr(args, "otel_endpoint", None),
+        otel_service_name=getattr(args, "otel_service_name", "smg-tpu"),
     )
     if getattr(args, "provider_config", None):
         ctx.providers.load_config(args.provider_config)
@@ -158,62 +161,42 @@ async def _run_gateway(args) -> int:
         + [(u, WorkerType.PREFILL) for u in getattr(args, "prefill_workers", [])]
         + [(u, WorkerType.DECODE) for u in getattr(args, "decode_workers", [])]
     )
-    async def _register_worker(url: str, wtype, deadline: float) -> None:
-        """Register one worker, retrying within the shared startup budget —
-        a worker still starting up must not kill (or serialize) the gateway
-        (reference: worker_startup_timeout_secs)."""
-        from smg_tpu.rpc.client import GrpcWorkerClient
+    async def _register_worker(url: str, wtype, timeout: float) -> None:
+        """Register one worker through the registration workflow (reference:
+        registration rides the job queue + workflow engine,
+        server.rs:1107-1135) — model_info retries with backoff so a worker
+        still starting up must not kill (or serialize) the gateway, and a
+        failed registration stays resumable via POST /workflows/{id}/resume.
+        """
+        from smg_tpu.gateway.registration import WORKER_REGISTRATION
 
-        if url.startswith(("http://", "https://")):
-            from smg_tpu.gateway.http_worker import HttpWorkerClient
-
-            client = HttpWorkerClient(url)
-        else:
-            client = GrpcWorkerClient(url)
-        info = None
-        while True:
-            try:
-                info = await client.get_model_info()
-                break
-            except Exception as e:
-                if asyncio.get_event_loop().time() >= deadline:
-                    logger.error("worker %s unreachable at startup: %s; skipping", url, e)
-                    break
-                await asyncio.sleep(1.0)
-        if info is None:
-            await client.close()
-            return
-        model_id = info.get("model_id", "default")
-        ctx.registry.add(
-            Worker(
-                worker_id=url, client=client, model_id=model_id,
-                url=url, page_size=info.get("page_size") or None, worker_type=wtype,
-                dp_size=info.get("dp_size") or 1,
+        iid = await ctx.workflows.start(WORKER_REGISTRATION, {
+            "url": url,
+            "worker_type": wtype.value,
+            "skip_tokenizer": not fetch_bundles,
+        })
+        inst = await ctx.workflows.wait(iid, timeout=timeout)
+        if inst.status.value != "completed":
+            logger.error(
+                "worker %s registration %s at startup (%s); resumable as %s",
+                url, inst.status.value, inst.error, iid,
             )
-        )
-        # no tokenizer mirrored onto the gateway host? fetch the worker's
-        # bundle (reference: GetTokenizer at registration)
-        if fetch_bundles and not ctx.tokenizers.has(model_id):
-            try:
-                tok = await client.get_tokenizer()
-            except Exception as e:
-                logger.warning("tokenizer bundle fetch failed from %s: %s", url, e)
-                tok = None
-            if tok is not None:
-                ctx.tokenizers.register(
-                    model_id, tok, default=ctx.tokenizers.get(None) is None
-                )
-                logger.info("tokenizer for %r fetched from worker %s", model_id, url)
 
     if role_urls:
-        startup_deadline = asyncio.get_event_loop().time() + 30.0
+        # the wait must outlast the workflow's model_info retry budget
+        # (~36s of backoff for a cold-booting worker) or a late success
+        # races the mock-fallback default below
         await asyncio.gather(
-            *(_register_worker(url, wtype, startup_deadline) for url, wtype in role_urls)
+            *(_register_worker(url, wtype, 75.0) for url, wtype in role_urls)
         )
 
     if args.command == "launch" and ctx.tokenizers.get(None) is None:
-        # nothing explicit and no worker handed one over: mock fallback
-        ctx.tokenizers.register("default", load_tokenizer(None), default=True)
+        # nothing explicit and no worker handed one over: mock fallback.
+        # Marked so a worker tokenizer arriving later (resumed/async
+        # registration) promotes itself to default over the mock.
+        fallback = load_tokenizer(None)
+        fallback._smg_fallback = True
+        ctx.tokenizers.register("default", fallback, default=True)
 
     mesh_node = None
     if getattr(args, "mesh_port", None) is not None:

@@ -537,10 +537,18 @@ class Router:
 
                 session = aiohttp.ClientSession()  # one pool for all fetches
             # fetch -> preprocess -> encode pipelines run concurrently per
-            # image; gather preserves prompt order
-            results = await asyncio.gather(
-                *(one_image(p, session) for p in parts)
-            )
+            # image; gather preserves prompt order.  On first failure the
+            # siblings are cancelled and drained so nothing touches the
+            # session after close (and no encode RPC burns worker time for
+            # a doomed request).
+            tasks = [asyncio.ensure_future(one_image(p, session)) for p in parts]
+            try:
+                results = await asyncio.gather(*tasks)
+            except BaseException:
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
         except ImageIngestError as e:
             raise RouteError(400, str(e))
         except RouteError:

@@ -48,6 +48,9 @@ class AppContext:
         rate_limit_config=None,
         priority_config=None,
         health_config=None,
+        storage: str | None = None,
+        otel_endpoint: str | None = None,
+        otel_service_name: str = "smg-tpu",
     ):
         from smg_tpu.gateway.auth import AuthConfig, Authenticator
         from smg_tpu.gateway.health import HealthMonitor
@@ -62,7 +65,15 @@ class AppContext:
         self.providers = ProviderRegistry()
         self.tokenizers = TokenizerRegistry()
         self.kv_monitor = KvEventMonitor(self.registry, self.policies)
-        self.router = Router(self.registry, self.policies, self.tokenizers, router_config)
+        from smg_tpu.gateway.router_manager import RouterManager
+
+        # multi-model (IGW) coordination: per-model routers over shared
+        # registries; ``self.router`` stays the default instance so
+        # single-model deployments and existing call sites are unchanged
+        self.routers = RouterManager(
+            self.registry, self.policies, self.tokenizers, router_config
+        )
+        self.router = self.routers.default
         self.semaphore = asyncio.Semaphore(max_concurrent_requests)
         self.metrics = Metrics()
         self.auth = Authenticator(auth_config or AuthConfig())
@@ -82,15 +93,43 @@ class AppContext:
         )
         from smg_tpu.gateway.responses import ResponsesHandler
         from smg_tpu.mcp import McpRegistry
-        from smg_tpu.storage import MemoryStorage
+        from smg_tpu.storage import make_storage
 
-        self.storage = MemoryStorage()
+        self.storage = make_storage(storage)
         self.mcp = McpRegistry()
         self.responses = ResponsesHandler(self.router, self.storage, self.mcp)
         self.discovery = None  # attached by build_app when running in-cluster
         # Plugin host (reference: wasm component host) — None until the
         # operator loads modules via --plugins; middleware no-ops without it.
         self.plugins = None
+        # Workflow engine + job queue (reference: server.rs:1107-1135):
+        # worker registration rides typed workflows; the queue is created
+        # lazily because it spawns tasks on the running loop.
+        from smg_tpu.gateway.registration import build_worker_registration
+        from smg_tpu.workflow import LoggingSubscriber, WorkflowEngine
+
+        self.workflows = WorkflowEngine()
+        self.workflows.bus.subscribe(LoggingSubscriber)
+        self.workflows.register(build_worker_registration(self))
+        self.jobs = None
+        # OTel tracing (reference: observability/otel_trace.rs) — off unless
+        # an OTLP endpoint is configured; spans correlate with request ids
+        self.tracer = None
+        if otel_endpoint:
+            from smg_tpu.gateway.tracing import OtelTracer
+
+            self.tracer = OtelTracer(otel_endpoint, otel_service_name)
+
+    def ensure_jobs(self):
+        if self.jobs is None:
+            from smg_tpu.workflow import JobQueue
+
+            self.jobs = JobQueue()
+        return self.jobs
+
+    def router_for(self, model_id: str | None) -> Router:
+        """Model-keyed router dispatch (IGW mode)."""
+        return self.routers.router_for(model_id)
 
     def load_plugins(self, specs, fail_open: bool | None = None):
         """Load middleware plugins (file paths or dotted modules).
@@ -153,6 +192,37 @@ async def request_id_middleware(request: web.Request, handler):
         return resp
     finally:
         request_id_var.reset(token)
+
+
+@web.middleware
+async def otel_middleware(request: web.Request, handler):
+    """One SERVER span per request, W3C traceparent in/out, request-id
+    correlated (reference: otel_trace.rs request spans).  No-op without a
+    configured tracer."""
+    ctx: AppContext = request.app["ctx"]
+    tracer = ctx.tracer
+    if tracer is None:
+        return await handler(request)
+    span = tracer.start_span(
+        f"{request.method} {request.path}",
+        traceparent=request.headers.get("traceparent"),
+    )
+    span.set("http.request.method", request.method)
+    span.set("url.path", request.path)
+    span.set("request.id", request.get("request_id", ""))
+    request["otel_span"] = span
+    try:
+        resp = await handler(request)
+        span.set("http.response.status_code", resp.status)
+        span.end(error=resp.status >= 500)
+        resp.headers.setdefault("traceparent", span.traceparent)
+        return resp
+    except Exception:
+        span.set("http.response.status_code", 500)
+        span.end(error=True)
+        raise
+    finally:
+        tracer.record(span)
 
 
 @web.middleware
@@ -311,14 +381,16 @@ async def _run_preemptable(ctx, request, handler, guard, priority: str):
 def build_app(ctx: AppContext) -> web.Application:
     app = web.Application(
         middlewares=[
-            request_id_middleware, error_middleware, plugin_middleware,
-            auth_middleware, admission_middleware,
+            request_id_middleware, otel_middleware, error_middleware,
+            plugin_middleware, auth_middleware, admission_middleware,
         ]
     )
     app["ctx"] = ctx
 
     async def _start_background(app):
         ctx.health_monitor.start()
+        if ctx.tracer is not None:
+            await ctx.tracer.start()
         from smg_tpu.gateway.discovery import KubeApi, ServiceDiscovery
 
         if ctx.discovery is None:
@@ -330,6 +402,10 @@ def build_app(ctx: AppContext) -> web.Application:
 
     async def _stop_background(app):
         ctx.health_monitor.stop()
+        if ctx.tracer is not None:
+            await ctx.tracer.stop()
+        if ctx.jobs is not None:
+            await ctx.jobs.close()
         if ctx.discovery is not None:
             await ctx.discovery.aclose()
         await ctx.providers.close()
@@ -379,6 +455,18 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_get("/workers", h_workers_list)
     app.router.add_post("/workers", h_workers_add)
     app.router.add_delete("/workers/{worker_id}", h_workers_remove)
+    # job queue + workflow introspection (reference: worker JobQueue +
+    # workflow engines, server.rs:1107-1135)
+    app.router.add_get("/jobs", h_jobs_list)
+    app.router.add_get("/jobs/{job_id}", h_job_get)
+    app.router.add_get("/workflows", h_workflows_list)
+    app.router.add_get("/workflows/{instance_id}", h_workflow_get)
+    app.router.add_post("/workflows/{instance_id}/resume", h_workflow_resume)
+    # multi-model (IGW) router management (reference: router_manager.rs)
+    app.router.add_get("/routers", h_routers_list)
+    app.router.add_get("/models/{model_id}/router", h_model_router_get)
+    app.router.add_post("/models/{model_id}/router", h_model_router_set)
+    app.router.add_delete("/models/{model_id}/router", h_model_router_reset)
     return app
 
 
@@ -460,19 +548,20 @@ async def h_chat(request: web.Request) -> web.Response | web.StreamResponse:
     adapter = ctx.providers.resolve(req.model)
     if adapter is not None:
         return await _chat_via_provider(request, ctx, adapter, req)
-    proxy_worker = ctx.router.select_proxy_worker(req.model)
+    router = ctx.router_for(req.model)
+    proxy_worker = router.select_proxy_worker(req.model)
     if proxy_worker is not None:
         return await _proxy_via_http_worker(
             request, ctx, proxy_worker, req, "/v1/chat/completions"
         )
     async with ctx.semaphore:
         if not req.stream:
-            resp = await ctx.router.chat(req, request_id=rid)
+            resp = await router.chat(req, request_id=rid)
             return web.json_response(resp.model_dump(exclude_none=True))
         sse = _sse_response(request)
         await sse.prepare(request)
         try:
-            async for chunk in ctx.router.chat_stream(req, request_id=rid):
+            async for chunk in router.chat_stream(req, request_id=rid):
                 data = chunk.model_dump(exclude_none=True)
                 await sse.write(f"data: {json.dumps(data)}\n\n".encode())
             await sse.write(b"data: [DONE]\n\n")
@@ -561,19 +650,20 @@ async def h_completions(request: web.Request) -> web.Response | web.StreamRespon
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
-    proxy_worker = ctx.router.select_proxy_worker(req.model)
+    router = ctx.router_for(req.model)
+    proxy_worker = router.select_proxy_worker(req.model)
     if proxy_worker is not None:
         return await _proxy_via_http_worker(
             request, ctx, proxy_worker, req, "/v1/completions"
         )
     async with ctx.semaphore:
         if not req.stream:
-            resp = await ctx.router.completion(req, request_id=rid)
+            resp = await router.completion(req, request_id=rid)
             return web.json_response(resp.model_dump(exclude_none=True))
         sse = _sse_response(request)
         await sse.prepare(request)
         try:
-            async for chunk in ctx.router.completion_stream(req, request_id=rid):
+            async for chunk in router.completion_stream(req, request_id=rid):
                 data = chunk.model_dump(exclude_none=True)
                 await sse.write(f"data: {json.dumps(data)}\n\n".encode())
             await sse.write(b"data: [DONE]\n\n")
@@ -673,7 +763,7 @@ async def h_embeddings(request: web.Request) -> web.Response:
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     async with ctx.semaphore:
-        resp = await ctx.router.embeddings(req, request_id=request["request_id"])
+        resp = await ctx.router_for(req.model).embeddings(req, request_id=request["request_id"])
         return web.json_response(resp.model_dump())
 
 
@@ -687,7 +777,7 @@ async def h_rerank(request: web.Request) -> web.Response:
         return _error(400, f"invalid request: {e}")
     async with ctx.semaphore:
         try:
-            resp = await ctx.router.rerank(req, request_id=request["request_id"])
+            resp = await ctx.router_for(req.model).rerank(req, request_id=request["request_id"])
         except RouteError as e:
             return _error(e.status, e.message, e.err_type)
         return web.json_response(resp.model_dump(exclude_none=True))
@@ -703,7 +793,7 @@ async def h_classify(request: web.Request) -> web.Response:
         return _error(400, f"invalid request: {e}")
     async with ctx.semaphore:
         try:
-            resp = await ctx.router.classify(req, request_id=request["request_id"])
+            resp = await ctx.router_for(req.model).classify(req, request_id=request["request_id"])
         except RouteError as e:
             return _error(e.status, e.message, e.err_type)
         return web.json_response(resp.model_dump())
@@ -720,12 +810,12 @@ async def h_anthropic_messages(request: web.Request) -> web.Response | web.Strea
     rid = request["request_id"]
     async with ctx.semaphore:
         if not req.stream:
-            resp = await ctx.router.anthropic_messages(req, request_id=rid)
+            resp = await ctx.router_for(req.model).anthropic_messages(req, request_id=rid)
             return web.json_response(resp.model_dump(exclude_none=True))
         sse = _sse_response(request)
         await sse.prepare(request)
         try:
-            async for event_name, payload in ctx.router.anthropic_messages_stream(req, request_id=rid):
+            async for event_name, payload in ctx.router_for(req.model).anthropic_messages_stream(req, request_id=rid):
                 await sse.write(
                     f"event: {event_name}\ndata: {json.dumps(payload)}\n\n".encode()
                 )
@@ -1057,37 +1147,69 @@ async def h_workers_list(request: web.Request) -> web.Response:
 
 
 async def h_workers_add(request: web.Request) -> web.Response:
-    """Register a remote worker by URL (gRPC)."""
+    """Register a remote worker by URL.  Registration runs as a workflow
+    (connect -> model_info with retry -> register -> tokenizer) — reference:
+    registration rides the job queue + workflow engine, server.rs:1107-1135.
+    ``"async": true`` enqueues and returns 202 with a job id to poll at
+    /jobs/{id}; the default waits inline.  Transport by scheme:
+    http(s):// = OpenAI-wire proxy worker, bare host:port = token-level gRPC.
+    """
     ctx: AppContext = request.app["ctx"]
+    from smg_tpu.gateway.registration import WORKER_REGISTRATION
+
     body = await request.json()
     url = body.get("url")
     if not url:
         return _error(400, "missing url")
-    # transport by scheme: http(s):// = OpenAI-wire proxy worker
-    # (routers/http/router.rs path); bare host:port = token-level gRPC
-    if url.startswith(("http://", "https://")):
-        from smg_tpu.gateway.http_worker import HttpWorkerClient
+    data = {
+        "url": url,
+        "worker_id": body.get("worker_id"),
+        "model_id": body.get("model_id"),
+        "api_key": body.get("api_key", ""),
+        "worker_type": body.get("worker_type"),
+        "skip_tokenizer": bool(body.get("skip_tokenizer")),
+    }
 
-        client = HttpWorkerClient(url, api_key=body.get("api_key", ""))
-    else:
-        from smg_tpu.rpc.client import GrpcWorkerClient
+    async def run_registration(timeout: float = 120.0) -> dict:
+        iid = await ctx.workflows.start(WORKER_REGISTRATION, data)
+        inst = await ctx.workflows.wait(iid, timeout=timeout)
+        if inst.status.value == "running":
+            # caller timed out: don't leave a zombie registration that
+            # surprises the operator later
+            await ctx.workflows.cancel(iid)
+            inst = await ctx.workflows.wait(iid, timeout=5.0)
+        if inst.status.value != "completed":
+            # failure/cancellation cleanup, shared by sync and async paths:
+            # a worker added by the register step must not stay routable
+            # with a transport we're about to close, and the client channel
+            # must not leak.  The connect step is reset so a later
+            # POST /workflows/{id}/resume re-dials cleanly.
+            if data.get("registered") and data.get("worker_id"):
+                ctx.registry.remove(data["worker_id"])
+                data["registered"] = False
+            client = data.pop("client", None)
+            if client is not None:
+                await client.close()
+            from smg_tpu.workflow import StepStatus
 
-        client = GrpcWorkerClient(url)
-    try:
-        info = await client.get_model_info()
-    except Exception as e:
-        await client.close()
-        return _error(502, f"worker unreachable: {e}", "worker_error")
-    worker = Worker(
-        worker_id=body.get("worker_id") or url,
-        client=client,
-        model_id=body.get("model_id") or info.get("model_id", "default"),
-        url=url,
-        page_size=info.get("page_size") or None,
-        dp_size=info.get("dp_size") or 1,
-    )
-    ctx.registry.add(worker)
-    return web.json_response({"added": worker.describe()})
+            for name in ("connect", "register"):
+                if inst.steps[name].status == StepStatus.SUCCEEDED:
+                    inst.steps[name].status = StepStatus.PENDING
+            await ctx.workflows.store.save(inst)
+        return inst.describe()
+
+    if body.get("async"):
+        job = ctx.ensure_jobs().submit(run_registration, name=f"register {url}")
+        return web.json_response(
+            {"job_id": job.job_id, "status": job.status}, status=202
+        )
+    desc = await run_registration()
+    if desc["status"] != "completed":
+        return _error(
+            502, f"worker registration failed: {desc.get('error')}", "worker_error"
+        )
+    worker = ctx.registry.get(data["worker_id"])
+    return web.json_response({"added": worker.describe(), "workflow": desc})
 
 
 async def h_workers_remove(request: web.Request) -> web.Response:
@@ -1118,3 +1240,91 @@ async def h_workers_remove(request: web.Request) -> web.Response:
     return web.json_response(
         {"removed": wid, "drained": drained, "in_flight_at_removal": worker.load}
     )
+
+
+# ---- multi-model (IGW) router management ----
+
+async def h_routers_list(request: web.Request) -> web.Response:
+    """All models' routing state: dedicated routers, policies, workers
+    (reference: RouterManager coordination surface)."""
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response(ctx.routers.describe())
+
+
+async def h_model_router_get(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response(
+        ctx.routers.describe_model(request.match_info["model_id"])
+    )
+
+
+async def h_model_router_set(request: web.Request) -> web.Response:
+    """Configure a model's routing: {"policy": name, "policy_args": {...},
+    "config": {RouterConfig overrides}} — any subset."""
+    ctx: AppContext = request.app["ctx"]
+    model_id = request.match_info["model_id"]
+    try:
+        body = await request.json()
+    except Exception:
+        return _error(400, "invalid JSON body")
+    try:
+        desc = ctx.routers.configure_model(
+            model_id,
+            policy=body.get("policy"),
+            policy_args=body.get("policy_args"),
+            config=body.get("config"),
+        )
+    except (ValueError, KeyError) as e:
+        return _error(400, str(e))
+    return web.json_response(desc)
+
+
+async def h_model_router_reset(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    model_id = request.match_info["model_id"]
+    existed = ctx.routers.reset_model(model_id)
+    return web.json_response({"model_id": model_id, "reset": existed})
+
+
+# ---- job queue + workflow introspection ----
+
+async def h_jobs_list(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    jobs = ctx.jobs.list() if ctx.jobs is not None else []
+    return web.json_response({"jobs": [j.describe() for j in jobs]})
+
+
+async def h_job_get(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    job = ctx.jobs.get(request.match_info["job_id"]) if ctx.jobs else None
+    if job is None:
+        return _error(404, f"no such job {request.match_info['job_id']}")
+    return web.json_response(job.describe())
+
+
+async def h_workflows_list(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    instances = await ctx.workflows.store.list(
+        request.query.get("type") or None
+    )
+    return web.json_response({"workflows": [i.describe() for i in instances]})
+
+
+async def h_workflow_get(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    inst = await ctx.workflows.store.load(request.match_info["instance_id"])
+    if inst is None:
+        return _error(404, f"no such workflow {request.match_info['instance_id']}")
+    return web.json_response(inst.describe())
+
+
+async def h_workflow_resume(request: web.Request) -> web.Response:
+    """Resume a failed registration (or any resumable workflow) from its
+    first incomplete step (reference: resume-on-failure semantics)."""
+    ctx: AppContext = request.app["ctx"]
+    iid = request.match_info["instance_id"]
+    ok = await ctx.workflows.resume(iid)
+    if not ok:
+        return _error(409, f"workflow {iid} is not resumable")
+    inst = await ctx.workflows.wait(iid, timeout=120.0)
+    return web.json_response(inst.describe())

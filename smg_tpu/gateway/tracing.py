@@ -1,0 +1,188 @@
+"""OpenTelemetry trace export — OTLP/HTTP+JSON, no SDK dependency.
+
+Reference: ``model_gateway/src/observability/otel_trace.rs`` — spans around
+request handling exported to an OTLP collector, correlated with request ids,
+W3C ``traceparent`` propagation in and out.  The reference uses the OTel
+Rust SDK over OTLP/gRPC; this environment has no otel library, so spans are
+built directly in the OTLP JSON encoding (a standard collector transport:
+``POST {endpoint}/v1/traces``) and shipped by a batching background task.
+
+Enabled by ``--otel-endpoint`` (off by default — zero overhead when off).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass, field
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("gateway.tracing")
+
+
+def _hex(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str  # 32 hex chars
+    span_id: str = field(default_factory=lambda: _hex(8))
+    parent_span_id: str = ""
+    kind: int = 2  # SPAN_KIND_SERVER
+    start_ns: int = field(default_factory=time.time_ns)
+    end_ns: int = 0
+    attributes: dict = field(default_factory=dict)
+    status_code: int = 0  # 0 unset, 1 ok, 2 error
+
+    def end(self, error: bool = False) -> None:
+        self.end_ns = time.time_ns()
+        self.status_code = 2 if error else 1
+
+    def set(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_otlp(self) -> dict:
+        def attr_value(v):
+            if isinstance(v, bool):
+                return {"boolValue": v}
+            if isinstance(v, int):
+                return {"intValue": str(v)}
+            if isinstance(v, float):
+                return {"doubleValue": v}
+            return {"stringValue": str(v)}
+
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            **({"parentSpanId": self.parent_span_id} if self.parent_span_id else {}),
+            "name": self.name,
+            "kind": self.kind,
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns or time.time_ns()),
+            "attributes": [
+                {"key": k, "value": attr_value(v)}
+                for k, v in self.attributes.items()
+            ],
+            "status": {"code": self.status_code},
+        }
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """W3C traceparent -> (trace_id, parent_span_id), or None if absent or
+    malformed (a malformed header starts a fresh trace, per spec)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    if parts[1] == "0" * 32 or parts[2] == "0" * 16:
+        return None
+    return parts[1].lower(), parts[2].lower()
+
+
+class OtelTracer:
+    """Span factory + batching OTLP/HTTP exporter."""
+
+    def __init__(self, endpoint: str, service_name: str = "smg-tpu",
+                 flush_interval: float = 2.0, max_batch: int = 512,
+                 max_buffer: int = 8192):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.flush_interval = flush_interval
+        self.max_batch = max_batch
+        self.max_buffer = max_buffer
+        self._buffer: list[Span] = []
+        self._task: asyncio.Task | None = None
+        self._session = None
+        self.exported = 0
+        self.dropped = 0
+
+    def start_span(self, name: str, traceparent: str | None = None,
+                   parent: Span | None = None, kind: int = 2) -> Span:
+        if parent is not None:
+            return Span(name=name, trace_id=parent.trace_id,
+                        parent_span_id=parent.span_id, kind=kind)
+        ctx = parse_traceparent(traceparent)
+        if ctx is not None:
+            return Span(name=name, trace_id=ctx[0], parent_span_id=ctx[1],
+                        kind=kind)
+        return Span(name=name, trace_id=_hex(16), kind=kind)
+
+    def record(self, span: Span) -> None:
+        """Queue a finished span; drops (and counts) past the buffer cap so
+        a dead collector can't grow memory without bound."""
+        if span.end_ns == 0:
+            span.end()
+        if len(self._buffer) >= self.max_buffer:
+            self.dropped += 1
+            return
+        self._buffer.append(span)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._pump())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.flush()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _pump(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval)
+            try:
+                await self.flush()
+            except Exception:
+                logger.exception("otel flush failed")
+
+    async def flush(self) -> None:
+        if not self._buffer:
+            return
+        import aiohttp
+
+        batch, self._buffer = self._buffer[:self.max_batch], self._buffer[self.max_batch:]
+        payload = {
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "smg_tpu.gateway"},
+                    "spans": [s.to_otlp() for s in batch],
+                }],
+            }]
+        }
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        try:
+            async with self._session.post(
+                self.endpoint + "/v1/traces", json=payload,
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                if resp.status >= 400:
+                    logger.warning("otel collector returned %d", resp.status)
+                    self.dropped += len(batch)
+                else:
+                    self.exported += len(batch)
+        except Exception as e:
+            # collector down: spans in this batch are dropped, later spans
+            # keep buffering — export must never wedge request handling
+            logger.warning("otel export failed: %s", e)
+            self.dropped += len(batch)

@@ -1,9 +1,14 @@
 """Chat-history storage (reference: ``crates/data_connector``, SURVEY.md §2.2).
 
 Storage traits (``ConversationStorage``/``ConversationItemStorage``/
-``ResponseStorage``, reference ``core.rs:132,225,434``) with in-memory and
-SQLite backends (the reference ships memory/noop/oracle/postgres/redis; SQLite
-is the in-tree durable stand-in with the same migration discipline).
+``ResponseStorage``, reference ``core.rs:132,225,434``) with in-memory,
+SQLite, Redis, and Postgres backends (reference ships
+memory/noop/oracle/postgres/redis).  The Redis and Postgres backends speak
+their wire protocols directly (``resp.py``, ``pgwire.py``) — this
+environment has no client libraries, and the protocols are small.
+
+Backend selection: ``make_storage("memory" | "sqlite:<path>" |
+"redis://..." | "postgres://...")``.
 """
 
 from smg_tpu.storage.core import (
@@ -17,6 +22,25 @@ from smg_tpu.storage.core import (
 from smg_tpu.storage.memory import MemoryStorage
 from smg_tpu.storage.sqlite import SqliteStorage
 
+
+def make_storage(spec: str | None):
+    """Storage factory keyed by URL scheme (reference: connector factory,
+    ``crates/data_connector/src/lib.rs``)."""
+    if not spec or spec == "memory":
+        return MemoryStorage()
+    if spec.startswith("sqlite:"):
+        return SqliteStorage(spec.split(":", 1)[1] or ":memory:")
+    if spec.startswith(("redis://", "rediss://")):
+        from smg_tpu.storage.redis import RedisStorage
+
+        return RedisStorage(url=spec)
+    if spec.startswith(("postgres://", "postgresql://")):
+        from smg_tpu.storage.postgres import PostgresStorage
+
+        return PostgresStorage(dsn=spec)
+    raise ValueError(f"unknown storage spec {spec!r}")
+
+
 __all__ = [
     "Conversation",
     "ConversationItem",
@@ -26,4 +50,5 @@ __all__ = [
     "StoredResponse",
     "MemoryStorage",
     "SqliteStorage",
+    "make_storage",
 ]

@@ -1,0 +1,40 @@
+"""Workflow engine + job queue (reference: ``crates/workflow/src/lib.rs`` —
+typed multi-step operations with retries, failure actions, persisted state,
+and an event bus; ``server.rs:1107-1135`` routes worker/tokenizer
+registration through it)."""
+
+from smg_tpu.workflow.core import (
+    BackoffStrategy,
+    FailureAction,
+    RetryPolicy,
+    StepDefinition,
+    StepStatus,
+    ValidationError,
+    WorkflowDefinition,
+    WorkflowInstance,
+    WorkflowStatus,
+)
+from smg_tpu.workflow.engine import WorkflowEngine
+from smg_tpu.workflow.events import EventBus, LoggingSubscriber, WorkflowEvent
+from smg_tpu.workflow.queue import Job, JobQueue
+from smg_tpu.workflow.state import InMemoryStore, StateStore
+
+__all__ = [
+    "BackoffStrategy",
+    "FailureAction",
+    "RetryPolicy",
+    "StepDefinition",
+    "StepStatus",
+    "ValidationError",
+    "WorkflowDefinition",
+    "WorkflowInstance",
+    "WorkflowStatus",
+    "WorkflowEngine",
+    "EventBus",
+    "LoggingSubscriber",
+    "WorkflowEvent",
+    "Job",
+    "JobQueue",
+    "InMemoryStore",
+    "StateStore",
+]

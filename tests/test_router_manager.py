@@ -1,0 +1,192 @@
+"""Multi-model RouterManager (IGW) e2e — one gateway, several models, each
+with its own router/policy (reference: router_manager.rs:1-5, factory.rs;
+VERDICT r3 next-round #3)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.gateway.router import RouterConfig
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient
+from smg_tpu.gateway.workers import Worker
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def make_engine(model_id: str) -> Engine:
+    return Engine(
+        EngineConfig(
+            model=tiny_test_config(),
+            cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                              dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=4, max_seq_len=128, max_prefill_tokens=32,
+                prefill_token_buckets=(16, 32), decode_batch_buckets=(4,),
+            ),
+            dtype="float32",
+            model_id=model_id,
+        ),
+        tokenizer=MockTokenizer(),
+    )
+
+
+@pytest.fixture(scope="module")
+def igw():
+    """Two models, one gateway: model-a (one worker), model-b (two workers)."""
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=300):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    engines = [make_engine("model-a"), make_engine("model-b"), make_engine("model-b")]
+    ctx = AppContext(
+        policy="round_robin",
+        router_config=RouterConfig(default_max_tokens=4),
+    )
+    ctx.tokenizers.register("model-a", MockTokenizer(), default=True)
+    ctx.tokenizers.register("model-b", MockTokenizer())
+    workers = [
+        Worker(worker_id="a0", client=InProcWorkerClient(engines[0]), model_id="model-a"),
+        Worker(worker_id="b0", client=InProcWorkerClient(engines[1]), model_id="model-b"),
+        Worker(worker_id="b1", client=InProcWorkerClient(engines[2]), model_id="model-b"),
+    ]
+
+    async def _setup():
+        for w in workers:
+            ctx.registry.add(w)
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.ctx, h.tc, h.workers = run, ctx, tc, {w.worker_id: w for w in workers}
+    yield h
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+    for e in engines:
+        e.stop()
+
+
+def _chat(h, model, **kw):
+    async def go():
+        r = await h.tc.post("/v1/chat/completions", json={
+            "model": model,
+            "messages": [{"role": "user", "content": "w5 w6"}],
+            "temperature": 0, "ignore_eos": True, **kw,
+        })
+        return r.status, await r.json()
+
+    return h.run(go())
+
+
+def test_model_keyed_dispatch(igw):
+    """Requests land only on the named model's workers."""
+    h = igw
+    for w in h.workers.values():
+        w.total_requests = 0
+    status, _ = _chat(h, "model-a", max_tokens=3)
+    assert status == 200
+    status, _ = _chat(h, "model-b", max_tokens=3)
+    assert status == 200
+    status, _ = _chat(h, "model-b", max_tokens=3)
+    assert status == 200
+    assert h.workers["a0"].total_requests == 1
+    # round_robin spread over model-b's two workers
+    assert h.workers["b0"].total_requests + h.workers["b1"].total_requests == 2
+    assert h.workers["b0"].total_requests == 1
+
+
+def test_models_aggregation(igw):
+    h = igw
+
+    async def go():
+        r = await h.tc.get("/v1/models")
+        return await r.json()
+
+    body = h.run(go())
+    ids = {m["id"] for m in body["data"]}
+    assert {"model-a", "model-b"} <= ids
+
+
+def test_per_model_router_config(igw):
+    """POST /models/{id}/router gives model-b a dedicated router whose
+    default_max_tokens differs from the shared default; model-a unaffected."""
+    h = igw
+
+    async def set_cfg():
+        r = await h.tc.post("/models/model-b/router", json={
+            "policy": "random",
+            "config": {"default_max_tokens": 2},
+        })
+        return r.status, await r.json()
+
+    status, desc = h.run(set_cfg())
+    assert status == 200
+    assert desc["dedicated_router"] is True
+    assert desc["policy"] == "random"
+    assert desc["config"]["default_max_tokens"] == 2
+    assert set(desc["workers"]) == {"b0", "b1"}
+
+    # no max_tokens in the request -> the per-model default applies
+    status, body = _chat(h, "model-b")
+    assert status == 200
+    assert body["usage"]["completion_tokens"] == 2
+    status, body = _chat(h, "model-a")
+    assert status == 200
+    assert body["usage"]["completion_tokens"] == 4  # shared default
+
+    # listing shows both models; reset restores the default router
+    async def listing():
+        r = await h.tc.get("/routers")
+        return await r.json()
+
+    all_desc = h.run(listing())
+    by_model = {m["model_id"]: m for m in all_desc["models"]}
+    assert by_model["model-b"]["dedicated_router"] is True
+    assert by_model["model-a"]["dedicated_router"] is False
+
+    async def reset():
+        r = await h.tc.delete("/models/model-b/router")
+        return await r.json()
+
+    assert h.run(reset())["reset"] is True
+    status, body = _chat(h, "model-b")
+    assert status == 200
+    assert body["usage"]["completion_tokens"] == 4
+
+
+def test_unknown_config_field_400(igw):
+    h = igw
+
+    async def go():
+        r = await h.tc.post("/models/model-a/router", json={
+            "config": {"no_such_knob": 1},
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 400
+    assert "no_such_knob" in str(body)
+
+
+def test_unknown_model_still_routes_default(igw):
+    """A model id with no workers falls back to the default router, which
+    404s/503s sensibly rather than crashing (single-model deployments ignore
+    the name — here candidates exist, so it serves)."""
+    h = igw
+    status, _ = _chat(h, "ghost-model", max_tokens=2)
+    # ghost model: candidate filter falls back to all workers (single-model
+    # semantics); the request serves — parity with pre-IGW behavior
+    assert status == 200

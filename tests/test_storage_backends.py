@@ -1,0 +1,421 @@
+"""Redis + Postgres storage backends over in-tree wire clients (VERDICT r3
+next-round #5).  Both are exercised against in-test protocol servers — a
+dict-backed RESP2 server and a sqlite-backed Postgres v3 server with
+SCRAM-SHA-256 auth — plus real servers when REDIS_URL / POSTGRES_DSN are set.
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import os
+import sqlite3
+import struct
+
+import pytest
+
+from smg_tpu.storage import ConversationItem, StoredResponse, make_storage
+from smg_tpu.storage.pgwire import PgClient, ScramClient, quote_literal
+from smg_tpu.storage.redis import RedisStorage
+from smg_tpu.storage.resp import RespClient, RespError
+
+
+# ---- fake RESP2 server (dict/list/zset subset) ----
+
+
+class FakeRedis:
+    def __init__(self):
+        self.kv: dict = {}
+        self.lists: dict = {}
+        self.zsets: dict = {}
+
+    def dispatch(self, args: list[bytes]):
+        cmd = args[0].decode().upper()
+        a = [x.decode() for x in args[1:]]
+        if cmd == "SET":
+            self.kv[a[0]] = args[2]
+            return "+OK"
+        if cmd == "GET":
+            v = self.kv.get(a[0])
+            return v if v is not None else None
+        if cmd == "DEL":
+            n = 0
+            for k in a:
+                n += self.kv.pop(k, None) is not None
+                n += self.lists.pop(k, None) is not None
+            return n
+        if cmd == "ZADD":
+            self.zsets.setdefault(a[0], {})[a[2]] = float(a[1])
+            return 1
+        if cmd == "ZREM":
+            return int(self.zsets.get(a[0], {}).pop(a[1], None) is not None)
+        if cmd == "ZRANGE":
+            members = sorted(self.zsets.get(a[0], {}).items(), key=lambda kv: kv[1])
+            lo, hi = int(a[1]), int(a[2])
+            hi = len(members) if hi == -1 else hi + 1
+            return [m.encode() for m, _ in members[lo:hi]]
+        if cmd == "RPUSH":
+            self.lists.setdefault(a[0], []).extend(a[1:])
+            return len(self.lists[a[0]])
+        if cmd == "LRANGE":
+            lst = self.lists.get(a[0], [])
+            lo, hi = int(a[1]), int(a[2])
+            hi = len(lst) if hi == -1 else hi + 1
+            return [x.encode() for x in lst[lo:hi]]
+        if cmd == "LREM":
+            lst = self.lists.get(a[0], [])
+            n = lst.count(a[2])
+            self.lists[a[0]] = [x for x in lst if x != a[2]]
+            return n
+        if cmd == "AUTH":
+            return "+OK"
+        if cmd == "SELECT":
+            return "+OK"
+        return RespError(f"ERR unknown command {cmd}")
+
+    @staticmethod
+    def encode_reply(v) -> bytes:
+        if isinstance(v, str) and v.startswith("+"):
+            return v.encode() + b"\r\n"
+        if isinstance(v, RespError):
+            return b"-" + str(v).encode() + b"\r\n"
+        if v is None:
+            return b"$-1\r\n"
+        if isinstance(v, int):
+            return b":%d\r\n" % v
+        if isinstance(v, bytes):
+            return b"$%d\r\n%s\r\n" % (len(v), v)
+        if isinstance(v, list):
+            return b"*%d\r\n" % len(v) + b"".join(
+                FakeRedis.encode_reply(x) for x in v
+            )
+        raise AssertionError(v)
+
+    async def serve(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                assert line[:1] == b"*"
+                n = int(line[1:-2])
+                args = []
+                for _ in range(n):
+                    hdr = await reader.readline()
+                    assert hdr[:1] == b"$"
+                    ln = int(hdr[1:-2])
+                    args.append((await reader.readexactly(ln + 2))[:-2])
+                writer.write(self.encode_reply(self.dispatch(args)))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def _start_fake_redis():
+    fake = FakeRedis()
+    server = await asyncio.start_server(fake.serve, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    return fake, server, port
+
+
+# ---- shared storage roundtrip (mirrors test_agentic matrix) ----
+
+
+async def _roundtrip(s):
+    conv = await s.create_conversation({"topic": "x"})
+    assert (await s.get_conversation(conv.id)).metadata == {"topic": "x"}
+    await s.update_conversation(conv.id, {"y": 1})
+    assert (await s.get_conversation(conv.id)).metadata == {"topic": "x", "y": 1}
+    assert [c.id for c in await s.list_conversations()] == [conv.id]
+
+    items = [
+        ConversationItem(type="message", role="user", content={"content": "hi"}),
+        ConversationItem(type="message", role="assistant", content={"content": "y'all"}),
+    ]
+    await s.add_items(conv.id, items)
+    got = await s.list_items(conv.id)
+    assert [i.role for i in got] == ["user", "assistant"]
+    assert got[1].content == {"content": "y'all"}  # quote-escaping survives
+    assert (await s.get_item(conv.id, got[0].id)).id == got[0].id
+    assert await s.delete_item(conv.id, got[0].id)
+    assert not await s.delete_item(conv.id, got[0].id)
+    assert len(await s.list_items(conv.id)) == 1
+
+    r1 = await s.store_response(StoredResponse(model="m", output=[{"type": "message"}]))
+    r2 = await s.store_response(StoredResponse(model="m", previous_response_id=r1.id))
+    chain = await s.response_chain(r2.id)
+    assert [r.id for r in chain] == [r1.id, r2.id]
+    assert await s.delete_response(r1.id)
+    assert await s.get_conversation("nope") is None
+    assert await s.delete_conversation(conv.id)
+    assert await s.get_conversation(conv.id) is None
+    assert await s.list_items(conv.id) == []
+
+
+def test_redis_storage_roundtrip_fake_server():
+    async def go():
+        fake, server, port = await _start_fake_redis()
+        s = RedisStorage(client=RespClient("127.0.0.1", port))
+        try:
+            await _roundtrip(s)
+            # all keys cleaned up by the deletes above
+            assert not any(k for k in fake.kv if "conv" in k)
+        finally:
+            await s.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_resp_pipeline_and_errors():
+    async def go():
+        _, server, port = await _start_fake_redis()
+        c = RespClient("127.0.0.1", port)
+        try:
+            replies = await c.pipeline([
+                ("SET", "a", "1"), ("GET", "a"), ("BOGUS",), ("GET", "missing"),
+            ])
+            assert replies[0] == "OK"
+            assert replies[1] == b"1"
+            assert isinstance(replies[2], RespError)
+            assert replies[3] is None
+        finally:
+            await c.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+@pytest.mark.skipif(not os.environ.get("REDIS_URL"), reason="no REDIS_URL")
+def test_redis_storage_roundtrip_real_server():
+    async def go():
+        s = make_storage(os.environ["REDIS_URL"])
+        try:
+            await _roundtrip(s)
+        finally:
+            await s.close()
+
+    asyncio.run(go())
+
+
+# ---- SCRAM-SHA-256 (RFC 7677 test vector) ----
+
+
+def test_scram_sha256_rfc7677_vector():
+    c = ScramClient("user", "pencil", nonce="rOprNGfwEbeRWgbNEkqO")
+    first = c.first_message()
+    assert first == b"n,,n=user,r=rOprNGfwEbeRWgbNEkqO"
+    server_first = (
+        b"r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+        b"s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+    )
+    final = c.final_message(server_first)
+    assert final == (
+        b"c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+        b"p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+    )
+    c.verify_server(b"v=6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4=")
+
+
+def test_quote_literal():
+    assert quote_literal(None) == "NULL"
+    assert quote_literal(5) == "5"
+    assert quote_literal(True) == "TRUE"
+    assert quote_literal("o'brien") == "'o''brien'"
+    with pytest.raises(ValueError):
+        quote_literal("a\x00b")
+
+
+# ---- fake Postgres server (sqlite-backed, SCRAM auth) ----
+
+
+class FakePg:
+    """Speaks enough of the v3 protocol to run the storage backend: startup,
+    SCRAM-SHA-256 auth (independent implementation from the RFC), simple
+    query against an in-memory sqlite with light SQL dialect shims."""
+
+    USER, PASSWORD = "smg", "hunter2"
+
+    def __init__(self):
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+
+    @staticmethod
+    def _msg(kind: bytes, payload: bytes) -> bytes:
+        return kind + struct.pack(">I", len(payload) + 4) + payload
+
+    async def serve(self, reader, writer):
+        try:
+            # startup
+            (ln,) = struct.unpack(">I", await reader.readexactly(4))
+            await reader.readexactly(ln - 4)
+            await self._auth(reader, writer)
+            writer.write(self._msg(b"Z", b"I"))
+            await writer.drain()
+            while True:
+                kind = await reader.readexactly(1)
+                (ln,) = struct.unpack(">I", await reader.readexactly(4))
+                payload = await reader.readexactly(ln - 4)
+                if kind == b"X":
+                    return
+                if kind == b"Q":
+                    self._query(payload[:-1].decode(), writer)
+                    writer.write(self._msg(b"Z", b"I"))
+                    await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _auth(self, reader, writer):
+        # request SASL/SCRAM-SHA-256
+        writer.write(self._msg(b"R", struct.pack(">I", 10) + b"SCRAM-SHA-256\x00\x00"))
+        await writer.drain()
+        kind = await reader.readexactly(1)
+        (ln,) = struct.unpack(">I", await reader.readexactly(4))
+        payload = await reader.readexactly(ln - 4)
+        assert kind == b"p"
+        mech_end = payload.index(b"\x00")
+        assert payload[:mech_end] == b"SCRAM-SHA-256"
+        (flen,) = struct.unpack(">I", payload[mech_end + 1:mech_end + 5])
+        client_first = payload[mech_end + 5:mech_end + 5 + flen].decode()
+        bare = client_first.split(",", 2)[2]
+        client_nonce = dict(p.split("=", 1) for p in bare.split(","))["r"]
+        # server first
+        salt = b"0123456789abcdef"
+        iters = 4096
+        server_nonce = client_nonce + "SRVNONCE"
+        server_first = (
+            f"r={server_nonce},s={base64.b64encode(salt).decode()},i={iters}"
+        )
+        writer.write(self._msg(b"R", struct.pack(">I", 11) + server_first.encode()))
+        await writer.drain()
+        # client final
+        kind = await reader.readexactly(1)
+        (ln,) = struct.unpack(">I", await reader.readexactly(4))
+        client_final = (await reader.readexactly(ln - 4)).decode()
+        without_proof, proof_b64 = client_final.rsplit(",p=", 1)
+        salted = hashlib.pbkdf2_hmac("sha256", self.PASSWORD.encode(), salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        auth_msg = ",".join([bare, server_first, without_proof]).encode()
+        sig = hmac.new(stored_key, auth_msg, hashlib.sha256).digest()
+        want_proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        assert base64.b64decode(proof_b64) == want_proof, "bad SCRAM proof"
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        server_sig = hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+        verifier = b"v=" + base64.b64encode(server_sig)
+        writer.write(self._msg(b"R", struct.pack(">I", 12) + verifier))
+        writer.write(self._msg(b"R", struct.pack(">I", 0)))
+        await writer.drain()
+
+    def _query(self, sql: str, writer) -> None:
+        # dialect shims: sqlite has no DOUBLE PRECISION/BIGINT distinctions
+        shimmed = (sql.replace("DOUBLE PRECISION", "REAL")
+                      .replace("BIGINT", "INTEGER"))
+        try:
+            cur = self.db.cursor()
+            rows = []
+            for stmt in [s for s in shimmed.split(";") if s.strip()]:
+                cur.execute(stmt)
+                if cur.description is not None:
+                    rows = cur.fetchall()
+            self.db.commit()
+            if cur.description is not None:
+                cols = [d[0] for d in cur.description]
+                desc = struct.pack(">H", len(cols))
+                for c in cols:
+                    desc += c.encode() + b"\x00" + struct.pack(
+                        ">IhIhih", 0, 0, 25, -1, -1, 0
+                    )
+                writer.write(self._msg(b"T", desc))
+                for row in rows:
+                    data = struct.pack(">H", len(row))
+                    for v in row:
+                        if v is None:
+                            data += struct.pack(">i", -1)
+                        else:
+                            b = str(v).encode()
+                            data += struct.pack(">i", len(b)) + b
+                    writer.write(self._msg(b"D", data))
+            writer.write(self._msg(b"C", b"OK\x00"))
+        except sqlite3.Error as e:
+            fields = f"SERROR\x00C42601\x00M{e}\x00\x00".encode()
+            writer.write(self._msg(b"E", fields))
+
+
+async def _start_fake_pg():
+    fake = FakePg()
+    server = await asyncio.start_server(fake.serve, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    return fake, server, port
+
+
+def test_postgres_storage_roundtrip_fake_server():
+    """Full storage matrix through the real PgClient (SCRAM auth included)
+    against the scripted server."""
+    from smg_tpu.storage.postgres import PostgresStorage
+
+    async def go():
+        _, server, port = await _start_fake_pg()
+        client = PgClient("127.0.0.1", port, user=FakePg.USER,
+                          password=FakePg.PASSWORD, database="smg")
+        s = PostgresStorage(client=client)
+        try:
+            await _roundtrip(s)
+        finally:
+            await s.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_pg_error_surfaces():
+    from smg_tpu.storage.pgwire import PgError
+
+    async def go():
+        _, server, port = await _start_fake_pg()
+        client = PgClient("127.0.0.1", port, user=FakePg.USER,
+                          password=FakePg.PASSWORD, database="smg")
+        try:
+            with pytest.raises(PgError):
+                await client.query("SELECT * FROM no_such_table")
+            # the connection survives an error (ReadyForQuery resync)
+            rows = await client.query("SELECT 1 AS one")
+            assert rows == [{"one": "1"}]
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+@pytest.mark.skipif(not os.environ.get("POSTGRES_DSN"), reason="no POSTGRES_DSN")
+def test_postgres_storage_roundtrip_real_server():
+    async def go():
+        s = make_storage(os.environ["POSTGRES_DSN"])
+        try:
+            await _roundtrip(s)
+        finally:
+            await s.close()
+
+    asyncio.run(go())
+
+
+def test_make_storage_schemes():
+    from smg_tpu.storage import MemoryStorage, SqliteStorage
+    from smg_tpu.storage.postgres import PostgresStorage
+    from smg_tpu.storage.redis import RedisStorage as RS
+
+    assert isinstance(make_storage(None), MemoryStorage)
+    assert isinstance(make_storage("memory"), MemoryStorage)
+    assert isinstance(make_storage("sqlite:"), SqliteStorage)
+    assert isinstance(make_storage("redis://h:1/2"), RS)
+    assert isinstance(make_storage("postgres://u:p@h/db"), PostgresStorage)
+    with pytest.raises(ValueError):
+        make_storage("bogus://x")

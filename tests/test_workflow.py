@@ -1,0 +1,390 @@
+"""Workflow engine + job queue (reference: ``crates/workflow`` semantics —
+retry/backoff, failure actions, events, resume; VERDICT r3 next-round #6)
+and worker registration riding it end-to-end."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.workflow import (
+    BackoffStrategy,
+    FailureAction,
+    JobQueue,
+    RetryPolicy,
+    StepDefinition,
+    ValidationError,
+    WorkflowDefinition,
+    WorkflowEngine,
+    WorkflowEvent,
+)
+
+FAST = RetryPolicy(max_attempts=3, backoff=BackoffStrategy("fixed", base=0.01))
+
+
+def test_definition_validation():
+    async def noop(d):
+        pass
+
+    with pytest.raises(ValidationError):
+        WorkflowDefinition("empty").validate()
+    d = WorkflowDefinition("dup", [
+        StepDefinition("a", noop), StepDefinition("a", noop),
+    ])
+    with pytest.raises(ValidationError):
+        d.validate()
+    with pytest.raises(ValidationError):
+        WorkflowDefinition("bad", [
+            StepDefinition("a", noop, retry=RetryPolicy(max_attempts=0)),
+        ]).validate()
+
+
+def test_backoff_schedules():
+    assert BackoffStrategy("fixed", base=2).delay(5) == 2
+    assert BackoffStrategy("linear", increment=1, max_delay=3).delay(2) == 2
+    assert BackoffStrategy("linear", increment=2, max_delay=3).delay(5) == 3
+    exp = BackoffStrategy("exponential", base=1, max_delay=10)
+    assert [exp.delay(i) for i in (1, 2, 3, 4, 5)] == [1, 2, 4, 8, 10]
+    with pytest.raises(ValidationError):
+        BackoffStrategy("bogus")
+
+
+def _engine_with_events():
+    engine = WorkflowEngine()
+    events: list[WorkflowEvent] = []
+    engine.bus.subscribe(events.append)
+    return engine, events
+
+
+def test_success_path_and_event_order():
+    async def go():
+        engine, events = _engine_with_events()
+
+        async def step1(d):
+            d["x"] = 1
+
+        async def step2(d):
+            d["y"] = d["x"] + 1
+
+        engine.register(WorkflowDefinition("wf", [
+            StepDefinition("one", step1), StepDefinition("two", step2),
+        ]))
+        iid = await engine.start("wf", {})
+        inst = await engine.wait(iid)
+        assert inst.status.value == "completed"
+        assert inst.data == {"x": 1, "y": 2}
+        assert [e.kind for e in events] == [
+            "workflow_started", "step_started", "step_succeeded",
+            "step_started", "step_succeeded", "workflow_completed",
+        ]
+
+    asyncio.run(go())
+
+
+def test_retry_then_success():
+    async def go():
+        engine, events = _engine_with_events()
+        attempts = {"n": 0}
+
+        async def flaky(d):
+            attempts["n"] += 1
+            if attempts["n"] < 3:
+                raise RuntimeError("transient")
+
+        engine.register(WorkflowDefinition("wf", [
+            StepDefinition("flaky", flaky, retry=FAST),
+        ]))
+        inst = await engine.wait(await engine.start("wf"))
+        assert inst.status.value == "completed"
+        assert inst.steps["flaky"].attempts == 3
+        assert [e.kind for e in events].count("step_retrying") == 2
+
+    asyncio.run(go())
+
+
+def test_fail_workflow_and_continue_next_step():
+    async def go():
+        engine, _ = _engine_with_events()
+
+        async def boom(d):
+            raise RuntimeError("kaput")
+
+        async def after(d):
+            d["after"] = True
+
+        engine.register(WorkflowDefinition("hard", [
+            StepDefinition("boom", boom, retry=FAST),
+            StepDefinition("after", after),
+        ]))
+        inst = await engine.wait(await engine.start("hard"))
+        assert inst.status.value == "failed"
+        assert inst.error == "kaput"
+        assert inst.steps["after"].status.value == "pending"
+        assert "after" not in inst.data
+
+        engine.register(WorkflowDefinition("soft", [
+            StepDefinition("boom", boom, retry=FAST,
+                           on_failure=FailureAction.CONTINUE_NEXT_STEP),
+            StepDefinition("after", after),
+        ]))
+        inst = await engine.wait(await engine.start("soft"))
+        assert inst.status.value == "completed"
+        assert inst.steps["boom"].status.value == "skipped"
+        assert inst.data["after"] is True
+
+    asyncio.run(go())
+
+
+def test_retry_indefinitely_until_cancel():
+    async def go():
+        engine, events = _engine_with_events()
+
+        async def forever(d):
+            raise RuntimeError("nope")
+
+        engine.register(WorkflowDefinition("wf", [
+            StepDefinition(
+                "forever", forever,
+                retry=RetryPolicy(max_attempts=1,
+                                  backoff=BackoffStrategy("fixed", base=0.01)),
+                on_failure=FailureAction.RETRY_INDEFINITELY,
+            ),
+        ]))
+        iid = await engine.start("wf")
+        await asyncio.sleep(0.15)
+        assert await engine.cancel(iid)
+        inst = await engine.wait(iid)
+        assert inst.status.value == "cancelled"
+        assert inst.steps["forever"].attempts > 3
+
+    asyncio.run(go())
+
+
+def test_step_timeout():
+    async def go():
+        engine, _ = _engine_with_events()
+
+        async def slow(d):
+            await asyncio.sleep(5)
+
+        engine.register(WorkflowDefinition("wf", [
+            StepDefinition("slow", slow, timeout=0.05,
+                           retry=RetryPolicy(max_attempts=1)),
+        ]))
+        inst = await engine.wait(await engine.start("wf"))
+        assert inst.status.value == "failed"
+
+    asyncio.run(go())
+
+
+def test_resume_from_failure():
+    """Failed step reruns on resume; succeeded steps do not repeat."""
+
+    async def go():
+        engine, _ = _engine_with_events()
+        runs = {"good": 0}
+        gate = {"open": False}
+
+        async def good(d):
+            runs["good"] += 1
+
+        async def gated(d):
+            if not gate["open"]:
+                raise RuntimeError("closed")
+            d["done"] = True
+
+        engine.register(WorkflowDefinition("wf", [
+            StepDefinition("good", good),
+            StepDefinition("gated", gated, retry=FAST),
+        ]))
+        iid = await engine.start("wf")
+        inst = await engine.wait(iid)
+        assert inst.status.value == "failed"
+        gate["open"] = True
+        assert await engine.resume(iid)
+        inst = await engine.wait(iid)
+        assert inst.status.value == "completed"
+        assert inst.data["done"] is True
+        assert runs["good"] == 1  # not re-run
+        # completed instances are not resumable
+        assert not await engine.resume(iid)
+
+    asyncio.run(go())
+
+
+def test_job_queue():
+    async def go():
+        q = JobQueue(concurrency=2)
+        try:
+            async def ok():
+                await asyncio.sleep(0.01)
+                return 42
+
+            async def bad():
+                raise ValueError("no")
+
+            j1, j2 = q.submit(ok, "ok"), q.submit(bad, "bad")
+            j1 = await q.wait(j1.job_id)
+            j2 = await q.wait(j2.job_id)
+            assert j1.status == "succeeded" and j1.result == 42
+            assert j2.status == "failed" and "no" in j2.error
+            assert {j.job_id for j in q.list()} >= {j1.job_id, j2.job_id}
+        finally:
+            await q.close()
+
+    asyncio.run(go())
+
+
+# ---- e2e: registration rides the workflow through the gateway ----
+
+
+@pytest.fixture(scope="module")
+def reg_stack():
+    from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+    from smg_tpu.engine.engine import Engine
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.models.config import tiny_test_config
+    from smg_tpu.rpc.server import serve_worker_async
+    from smg_tpu.tokenizer import MockTokenizer
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=300):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    engine = Engine(EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=2, max_seq_len=128, max_prefill_tokens=32,
+            prefill_token_buckets=(32,), decode_batch_buckets=(2,),
+        ),
+        dtype="float32", model_id="tiny-reg",
+    ), tokenizer=MockTokenizer())
+    engine.start()
+    ctx = AppContext(policy="round_robin")
+
+    async def _setup():
+        server = await serve_worker_async(engine, port=0, host="127.0.0.1")
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return server, tc
+
+    server, tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.ctx, h.tc = run, ctx, tc
+    h.worker_url = f"127.0.0.1:{server._bound_port}"
+    yield h
+    run(tc.close())
+    run(server.stop(grace=None))
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+def test_worker_add_via_workflow_sync(reg_stack):
+    h = reg_stack
+
+    async def go():
+        r = await h.tc.post("/workers", json={"url": h.worker_url,
+                                              "worker_id": "wf-sync"})
+        body = await r.json()
+        return r.status, body
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["added"]["worker_id"] == "wf-sync"
+    assert body["workflow"]["status"] == "completed"
+    steps = body["workflow"]["steps"]
+    assert steps["model_info"]["status"] == "succeeded"
+    assert steps["tokenizer"]["status"] == "succeeded"
+    assert h.ctx.registry.get("wf-sync") is not None
+    assert h.ctx.tokenizers.has("tiny-reg")
+
+    async def cleanup():
+        await h.tc.delete("/workers/wf-sync", params={"drain": "0"})
+
+    h.run(cleanup())
+
+
+def test_worker_add_async_job(reg_stack):
+    h = reg_stack
+
+    async def go():
+        r = await h.tc.post("/workers", json={
+            "url": h.worker_url, "worker_id": "wf-async", "async": True,
+        })
+        assert r.status == 202
+        job_id = (await r.json())["job_id"]
+        for _ in range(200):
+            jr = await h.tc.get(f"/jobs/{job_id}")
+            jb = await jr.json()
+            if jb["status"] in ("succeeded", "failed"):
+                return jb
+            await asyncio.sleep(0.05)
+        raise TimeoutError
+
+    jb = h.run(go())
+    assert jb["status"] == "succeeded", jb
+    assert jb["result"]["status"] == "completed"
+    assert h.ctx.registry.get("wf-async") is not None
+
+    async def cleanup():
+        await h.tc.delete("/workers/wf-async", params={"drain": "0"})
+
+    h.run(cleanup())
+
+
+def test_failed_registration_is_resumable(reg_stack):
+    """Registration against a dead port fails after retries; once a worker
+    is listening there, POST /workflows/{id}/resume completes it without
+    repeating succeeded steps (reference: resume-on-failure)."""
+    h = reg_stack
+
+    async def fail_then_resume():
+        # an unused port: connect succeeds (lazy gRPC), model_info fails
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        r = await h.tc.post("/workers", json={
+            "url": f"127.0.0.1:{dead_port}", "worker_id": "wf-resume",
+        })
+        assert r.status == 502
+        wr = await h.tc.get("/workflows")
+        body = await wr.json()
+        failed = [w for w in body["workflows"]
+                  if w["status"] == "failed"
+                  and w["steps"]["model_info"]["status"] == "failed"]
+        assert failed, body
+        iid = failed[-1]["instance_id"]
+        # now point the instance at the live worker by rebinding its data —
+        # operators would instead restart the worker on the same port; we
+        # simulate by swapping the stored client's channel target
+        inst = await h.ctx.workflows.store.load(iid)
+        old_client = inst.data.get("client")
+        if old_client is not None:
+            await old_client.close()
+        from smg_tpu.rpc.client import GrpcWorkerClient
+
+        inst.data["client"] = GrpcWorkerClient(h.worker_url)
+        inst.data["url"] = h.worker_url
+        rr = await h.tc.post(f"/workflows/{iid}/resume")
+        desc = await rr.json()
+        assert rr.status == 200, desc
+        assert desc["status"] == "completed"
+        # connect step was not repeated (attempts stayed at 1)
+        assert desc["steps"]["connect"]["attempts"] == 1
+        assert h.ctx.registry.get("wf-resume") is not None
+        await h.tc.delete("/workers/wf-resume", params={"drain": "0"})
+
+    h.run(fail_then_resume())
