@@ -80,6 +80,18 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--tokenizer-path", default=None)
     g.add_argument("--tp", type=int, default=1, help="tensor parallel size")
     g.add_argument("--dp", type=int, default=1, help="data parallel size")
+    g.add_argument("--pp", type=int, default=1,
+                   help="pipeline parallel size (layer stack + KV sharded)")
+    g.add_argument("--sp", type=int, default=1,
+                   help="sequence parallel size (ring-attention prefill)")
+    g.add_argument("--ep", type=int, default=1, help="expert parallel size (MoE)")
+    g.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32", "float16"],
+                   help="compute/weight dtype (bfloat16 on TPU; float32 for "
+                        "CPU smoke runs)")
+    g.add_argument("--kv-dtype", default=None, dest="kv_dtype",
+                   choices=["bfloat16", "float32", "float16", "int8"],
+                   help="KV cache dtype (default: follow --dtype)")
     g.add_argument("--max-batch-size", type=int, default=64)
     g.add_argument("--max-seq-len", type=int, default=8192)
     g.add_argument("--page-size", type=int, default=16)

@@ -22,11 +22,16 @@ from typing import Callable
 from smg_tpu.protocols.events import AllBlocksCleared, BlockRemoved, BlockStored, KvEvent
 
 
-def _chain_hash(parent_hash: int, tokens: tuple[int, ...]) -> int:
+def _chain_hash(parent_hash: int, tokens: tuple[int, ...],
+                extra_key: int = 0) -> int:
     h = hashlib.blake2b(digest_size=8)
     h.update(parent_hash.to_bytes(8, "little", signed=False))
     for t in tokens:
         h.update(int(t).to_bytes(4, "little", signed=False))
+    if extra_key:
+        # multimodal content salt (reference: mm extra keys in block hashes —
+        # same token ids, different pixels, different chain)
+        h.update(int(extra_key).to_bytes(8, "little", signed=False))
     return int.from_bytes(h.digest(), "little")
 
 
@@ -66,14 +71,30 @@ class RadixCache:
 
     # ---- lookup ----
 
-    def match_prefix(self, tokens: list[int]) -> tuple[list[int], RadixNode]:
+    @staticmethod
+    def _page_key(tokens: list[int], i: int, ps: int,
+                  extra_keys: "list[int] | None") -> tuple:
+        """Tree key for the page starting at token ``i``.  Pages overlapped
+        by multimodal content append a content-hash salt so identical
+        placeholder token runs with different pixels never alias
+        (reference: mm extra keys); text-only pages keep the bare tuple so
+        existing chains and hashes are unchanged."""
+        key = tuple(tokens[i : i + ps])
+        extra = extra_keys[i // ps] if extra_keys and i // ps < len(extra_keys) else 0
+        if extra:
+            return key + (("mm", extra),)
+        return key
+
+    def match_prefix(
+        self, tokens: list[int], extra_keys: "list[int] | None" = None
+    ) -> tuple[list[int], RadixNode]:
         """Longest cached prefix in full pages.  Returns (pages, deepest node).
         Does NOT pin; call ``lock`` on the node to protect from eviction."""
         node = self.root
         pages: list[int] = []
         ps = self.page_size
         for i in range(0, len(tokens) - ps + 1, ps):
-            key = tuple(tokens[i : i + ps])
+            key = self._page_key(tokens, i, ps, extra_keys)
             child = node.children.get(key)
             if child is None:
                 break
@@ -97,13 +118,17 @@ class RadixCache:
 
     # ---- insert ----
 
-    def insert(self, tokens: list[int], pages: list[int]) -> list[tuple[int, int]]:
+    def insert(
+        self, tokens: list[int], pages: list[int],
+        extra_keys: "list[int] | None" = None,
+    ) -> list[tuple[int, int]]:
         """Insert the full-page chains of ``tokens`` whose KV lives in ``pages``
         (pages[i] holds tokens[i*ps:(i+1)*ps]).  Ownership of inserted pages
         moves to the tree.  Returns ``(page_index, page)`` duplicates whose
         chain already existed (the caller frees the ones it owns — e.g. two
         requests computed the same prefix concurrently; indices below the
-        caller's shared-prefix count are the tree's own pages)."""
+        caller's shared-prefix count are the tree's own pages).
+        ``extra_keys`` (per page, 0 = none) carry mm content salts."""
         ps = self.page_size
         node = self.root
         dupes: list[tuple[int, int]] = []
@@ -114,14 +139,17 @@ class RadixCache:
             pg_idx = i // ps
             if pg_idx >= len(pages):
                 break
-            key = tuple(tokens[i : i + ps])
+            page_tokens = tuple(tokens[i : i + ps])
+            extra = (extra_keys[pg_idx]
+                     if extra_keys and pg_idx < len(extra_keys) else 0)
+            key = self._page_key(tokens, i, ps, extra_keys)
             child = node.children.get(key)
             if child is not None:
                 dupes.append((pg_idx, pages[pg_idx]))
                 node = child
                 self._touch(node)
                 continue
-            block_hash = _chain_hash(node.block_hash, key)
+            block_hash = _chain_hash(node.block_hash, page_tokens, extra)
             child = RadixNode(
                 key=key, page=pages[pg_idx], parent=node, block_hash=block_hash
             )
@@ -130,7 +158,7 @@ class RadixCache:
             if not stored_hashes:
                 parent_hash_for_event = node.block_hash if node is not self.root else None
             stored_hashes.append(block_hash)
-            stored_tokens.extend(key)
+            stored_tokens.extend(page_tokens)
             node = child
             self._touch(node)
         if stored_hashes:

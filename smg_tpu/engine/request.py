@@ -57,6 +57,8 @@ class EngineRequest:
     # (embeds [M, E] float32, positions [M] int32).  Reference: the EPD
     # encode leg ships vision-tower output to prefill (``stages/encode.rs``).
     mm_embeds: tuple | None = None
+    # per-page content-hash salts for radix keying (scheduler-computed)
+    mm_extra_keys: "list[int] | None" = None
 
     @property
     def prompt_len(self) -> int:

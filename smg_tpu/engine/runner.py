@@ -69,7 +69,17 @@ class ModelRunner:
         self.config = config
         self.model_cfg = config.model
         self.module = get_model(self.model_cfg.arch)
-        self.rules = ShardingRules()
+        # serving pp: the layer axis of the param stack AND the KV cache
+        # shard over "pp" (parallel/pp_serving.py); each stage holds L/S
+        # layers — the capacity path for models that don't fit TP-only
+        self.use_pp = config.parallel.pp > 1
+        if self.use_pp:
+            base = ShardingRules()
+            self.rules = ShardingRules(
+                rules={**base.rules, "layers": "pp"}
+            )
+        else:
+            self.rules = ShardingRules()
 
         world = config.parallel.world_size
         self.mesh = build_mesh(config.parallel, devices=devices) if world > 1 else None
@@ -197,6 +207,8 @@ class ModelRunner:
         relayout-free); long contexts: the gather materializes B*mp*ps*KD
         bytes per layer and the page-streaming pallas kernel wins.
         Crossover measured at ~100k gathered tokens (1B model, v5e)."""
+        if self.use_pp:
+            return "xla"  # pallas kernels don't run inside the pp shard_map
         if self.attn_impl != "auto":
             return self.attn_impl
         return "pallas" if B * mp * self.spec.page_size > 131072 else "xla"
@@ -208,6 +220,8 @@ class ModelRunner:
         prefix is short.  Explicit config wins; "auto" uses a capacity
         threshold (small tables: the fused gather is relayout-free and
         cheap)."""
+        if self.use_pp:
+            return "xla"
         if self.attn_impl == "xla":
             return "xla"
         d = self.model_cfg.head_dim
@@ -299,6 +313,9 @@ class ModelRunner:
         """Install (or replace) an adapter in the bank; returns its slot."""
         from smg_tpu.models.lora import canonical_keys, validate_adapter
 
+        if self.use_pp:
+            raise ValueError("LoRA adapters are not supported with serving pp yet")
+
         rank = validate_adapter(self.model_cfg, weights)
         N = self.lora_slots
         if self._lora_bank is None:
@@ -362,6 +379,7 @@ class ModelRunner:
         module = self.module
         n_slots = self.lora_slots
         sp_mesh = self.mesh if use_ring else None
+        pp_mesh = self.mesh if self.use_pp else None
 
         def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
                  key, temp, topk, topp, minp, *extra):
@@ -386,6 +404,7 @@ class ModelRunner:
                 lora=lora_bank, lora_gates=lora_gates, sp_mesh=sp_mesh,
                 attn_impl=impl,
                 input_embeds=input_embeds, embeds_mask=embeds_mask,
+                pp_mesh=pp_mesh,
             )
             logits = logits[None]
             if use_pen:
@@ -413,8 +432,9 @@ class ModelRunner:
 
     def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False,
                             use_pen: bool = False, use_mask: bool = False,
-                            use_lora: bool = False):
-        k = ("prefill_batched", G, T, mp, no_ctx, use_pen, use_mask, use_lora)
+                            use_lora: bool = False, use_embeds: bool = False):
+        k = ("prefill_batched", G, T, mp, no_ctx, use_pen, use_mask, use_lora,
+             use_embeds)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -435,9 +455,14 @@ class ModelRunner:
             if use_lora:
                 lora_bank, lora_idx = extra[i], extra[i + 1]
                 lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
+                i += 2
+            input_embeds = embeds_mask = None
+            if use_embeds:
+                input_embeds, embeds_mask = extra[i], extra[i + 1]
             logits, kc, vc = module.forward_prefill_batched(
                 params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
                 no_ctx=no_ctx, lora=lora_bank, lora_gates=lora_gates,
+                input_embeds=input_embeds, embeds_mask=embeds_mask,
             )
             if use_pen:
                 logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
@@ -445,7 +470,8 @@ class ModelRunner:
                                         mask=mask)
             return toks, lps, kc, vc
 
-        n_extra = (5 if use_pen else 0) + (1 if use_mask else 0) + (2 if use_lora else 0)
+        n_extra = ((5 if use_pen else 0) + (1 if use_mask else 0)
+                   + (2 if use_lora else 0) + (2 if use_embeds else 0))
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -472,6 +498,7 @@ class ModelRunner:
         pen: tuple | None = None,  # (counts [G_real,V], pmask [G_real,V], freqs, pres, reps)
         mask: np.ndarray | None = None,  # [G_real, V] bool
         lora_idx: np.ndarray | None = None,  # [G_real] adapter slot per row
+        mm: "list[tuple | None] | None" = None,  # per-row (dense [t,E], bool [t])
     ) -> tuple[np.ndarray, np.ndarray]:
         """Prefill several single-chunk sequences in one call.
         Returns (tokens [G_real], logprobs [G_real])."""
@@ -502,10 +529,12 @@ class ModelRunner:
             fminps[i] = minps[i]
         no_ctx = all(c[1] == 0 for c in chunks)
         use_lora = lora_idx is not None and self._lora_bank is not None
+        use_embeds = mm is not None and any(m is not None for m in mm)
         fn = self._prefill_batched_fn(G, T, mp, no_ctx,
                                       use_pen=pen is not None,
                                       use_mask=mask is not None,
-                                      use_lora=use_lora)
+                                      use_lora=use_lora,
+                                      use_embeds=use_embeds)
         args = [
             self.params,
             self.inv_freq,
@@ -537,6 +566,16 @@ class ModelRunner:
                 self._lora_bank,
                 jnp.asarray(_pad_vec(np.asarray(lora_idx, np.int32), G, 0)),
             ]
+        if use_embeds:
+            E = next(m[0].shape[1] for m in mm if m is not None)
+            dense = np.zeros((G, T, E), np.float32)
+            emask = np.zeros((G, T), bool)
+            for i, m in enumerate(mm):
+                if m is not None:
+                    d, bm = m
+                    dense[i, : d.shape[0]] = d
+                    emask[i, : bm.shape[0]] = bm
+            args += [jnp.asarray(dense), jnp.asarray(emask)]
         toks, lps, self.k_cache, self.v_cache = fn(*args)
         return np.asarray(toks)[:g_real], np.asarray(lps)[:g_real]
 
@@ -595,6 +634,7 @@ class ModelRunner:
                     params, cfg, inv_freq, toks, entry_pos + j, entry_pos, j,
                     kc, vc, page_tables, hk, hv, attn_impl=attn_impl,
                     lora=lora_bank, lora_gates=lora_gates,
+                    pp_mesh=(self.mesh if self.use_pp else None),
                 )
                 if use_pen:
                     logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
@@ -771,6 +811,7 @@ class ModelRunner:
         sp = self.config.parallel.sp
         use_ring = (
             self.mesh is not None and sp > 1 and prefix_len == 0 and T % sp == 0
+            and not self.use_pp  # ring + pp composition is future work
         )
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
                               use_mask=mask is not None, use_lora=use_lora,

@@ -153,14 +153,17 @@ class Scheduler:
                     continue
 
                 # radix prefix match (never match the full prompt: at least
-                # one token must be computed to produce logits)
-                # mm requests bypass the radix cache entirely: placeholder
-                # token ids are identical across different images, so a
-                # token-keyed prefix match would alias distinct pixel content
+                # one token must be computed to produce logits).
+                # mm requests participate via per-page content-hash extra
+                # keys (reference approach): identical placeholder token
+                # runs with different pixels hash to different chains, so
+                # repeated image prompts DO share KV instead of re-encoding
                 shared_pages: list[int] = []
                 node = None
-                if self.radix is not None and req.mm_embeds is None:
-                    shared_pages, node = self.radix.match_prefix(prompt[:-1])
+                if self.radix is not None:
+                    shared_pages, node = self.radix.match_prefix(
+                        prompt[:-1], extra_keys=self._mm_extra_keys(req)
+                    )
                 matched_tokens = len(shared_pages) * self.ps
                 prompt_pages_total = math.ceil(len(prompt) / self.ps)
                 need = prompt_pages_total - len(shared_pages)
@@ -187,9 +190,14 @@ class Scheduler:
                 self.slots[slot] = req
 
                 remaining = len(prompt) - matched_tokens
-                if remaining > self.sched.max_prefill_tokens or req.mm_embeds is not None:
+                if (remaining > self.sched.max_prefill_tokens
+                        or getattr(self.runner, "use_pp", False)):
+                    # pp serving: grouped prefill isn't pp-wired yet, run
+                    # every prompt through the (pp-capable) solo chunk loop
                     self._prefill_solo(req, prompt, matched_tokens, outputs)
                 else:
+                    # mm requests batch like text: the group path splices
+                    # per-row embeddings (r3 forced them solo — weak #6)
                     group.append(req)
             if group:
                 self._prefill_group(group, outputs)
@@ -249,6 +257,33 @@ class Scheduler:
         req.seq_len = len(prompt)
         self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
 
+    def _mm_extra_keys(self, req: EngineRequest) -> "list[int] | None":
+        """Per-page mm content salts for radix keying (reference: extra keys
+        mixed into block hashes).  Page p's salt digests the embedding rows
+        and in-page offsets of every placeholder position the page covers;
+        0 = page has no mm content.  Computed once per request."""
+        if req.mm_embeds is None:
+            return None
+        if req.mm_extra_keys is not None:
+            return req.mm_extra_keys
+        import hashlib
+
+        embeds, positions = req.mm_embeds
+        n_pages = math.ceil(len(req.prompt_ids) / self.ps)
+        keys = [0] * n_pages
+        order = np.argsort(positions)
+        for p in range(n_pages):
+            lo, hi = p * self.ps, (p + 1) * self.ps
+            sel = order[(positions[order] >= lo) & (positions[order] < hi)]
+            if sel.size == 0:
+                continue
+            h = hashlib.blake2b(digest_size=8)
+            h.update(np.ascontiguousarray(positions[sel] - lo).tobytes())
+            h.update(np.ascontiguousarray(embeds[sel], np.float32).tobytes())
+            keys[p] = int.from_bytes(h.digest(), "little") or 1
+        req.mm_extra_keys = keys
+        return keys
+
     def _mm_chunk(self, req: EngineRequest, start: int, chunk_len: int):
         """Slice the request's mm embeddings for one prefill chunk: a dense
         [chunk_len, E] buffer + bool mask selecting placeholder rows."""
@@ -284,10 +319,12 @@ class Scheduler:
         mask_arr = np.ones((g, V), bool) if use_mask else None
         use_lora = any(r.lora_idx for r in group)
         lora_idx = np.array([r.lora_idx for r in group], np.int32) if use_lora else None
+        mm_rows: list = []
         for i, req in enumerate(group):
             prompt = req.all_token_ids
             chunk = prompt[req.cached_tokens :]
             chunks.append((chunk, req.cached_tokens, self.page_tables[req.slot]))
+            mm_rows.append(self._mm_chunk(req, req.cached_tokens, len(chunk)))
             sp = req.sampling
             temps[i] = sp.temperature
             topks[i] = sp.top_k
@@ -306,6 +343,7 @@ class Scheduler:
             pen=(counts, pmask, freqs, pres, reps) if use_pen else None,
             mask=mask_arr,
             lora_idx=lora_idx,
+            mm=mm_rows if any(m is not None for m in mm_rows) else None,
         )
         for i, req in enumerate(group):
             req.seq_len = req.total_len
@@ -602,9 +640,14 @@ class Scheduler:
         full_pages = len(tokens) // self.ps
         n_shared = len(req.shared_pages)
         to_free: list[int] = []
-        if self.radix is not None and finish.reason != "error" and req.mm_embeds is None:
+        if self.radix is not None and finish.reason != "error":
             all_pages = req.shared_pages + req.owned_pages
-            dupes = self.radix.insert(tokens, all_pages[:full_pages])
+            # mm pages insert with their content salts (pages past the
+            # prompt get 0 via the key helper's bounds guard)
+            dupes = self.radix.insert(
+                tokens, all_pages[:full_pages],
+                extra_keys=self._mm_extra_keys(req),
+            )
             for idx, page in dupes:
                 if idx >= n_shared:
                     to_free.append(page)

@@ -53,12 +53,22 @@ def build_engine_from_args(args):
         model=model,
         model_path=args.model_path,
         tokenizer_path=args.tokenizer_path or args.model_path,
-        parallel=ParallelConfig(dp=args.dp, tp=args.tp),
-        cache=CacheConfig(page_size=args.page_size),
+        parallel=ParallelConfig(
+            dp=args.dp, tp=args.tp,
+            pp=getattr(args, "pp", 1), sp=getattr(args, "sp", 1),
+            ep=getattr(args, "ep", 1),
+        ),
+        cache=CacheConfig(
+            page_size=args.page_size,
+            # KV follows the compute dtype unless the operator overrides
+            # (bf16 cache under f32 compute would silently mix precisions)
+            dtype=getattr(args, "kv_dtype", None) or getattr(args, "dtype", "bfloat16"),
+        ),
         scheduler=SchedulerConfig(
             max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len
         ),
         model_id=args.model_path or args.model_preset,
+        dtype=getattr(args, "dtype", "bfloat16"),
     )
     params = None
     vision_params = None

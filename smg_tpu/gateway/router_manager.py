@@ -61,9 +61,12 @@ class RouterManager:
         ``config`` keys must be RouterConfig fields; a dedicated Router is
         created (or replaced) only when config overrides are given — a
         policy-only change rides the shared default router, which resolves
-        policies per model already."""
-        if policy is not None:
-            self.policies.set_policy(model_id, policy, **(policy_args or {}))
+        policies per model already.
+
+        Validation is atomic: everything is checked (and the policy/router
+        constructed) BEFORE any routing state mutates, so a 400 response
+        really means nothing changed."""
+        new_router = None
         if config:
             unknown = set(config) - _CONFIG_FIELDS
             if unknown:
@@ -72,9 +75,19 @@ class RouterManager:
                     f"known: {sorted(_CONFIG_FIELDS)}"
                 )
             cfg = dataclasses.replace(self.default.config, **config)
-            self._per_model[model_id] = Router(
+            new_router = Router(
                 self.registry, self.policies, self.tokenizers, cfg
             )
+        if policy is not None:
+            from smg_tpu.policies.base import get_policy
+
+            try:
+                get_policy(policy, **(policy_args or {}))  # dry construct
+            except TypeError as e:
+                raise ValueError(f"invalid policy args for {policy!r}: {e}")
+            self.policies.set_policy(model_id, policy, **(policy_args or {}))
+        if new_router is not None:
+            self._per_model[model_id] = new_router
             logger.info("dedicated router configured for model %r: %s",
                         model_id, config)
         return self.describe_model(model_id)

@@ -176,6 +176,11 @@ def _sse_response(request: web.Request) -> web.StreamResponse:
             "X-Accel-Buffering": "no",
         },
     )
+    # trace propagation must be attached BEFORE prepare() sends the headers —
+    # the otel middleware's post-handler setdefault is a no-op for streams
+    span = request.get("otel_span")
+    if span is not None:
+        resp.headers["traceparent"] = span.traceparent
     # once prepared, bytes go out — a preempted request can no longer requeue
     request["response_started"] = True
     return resp

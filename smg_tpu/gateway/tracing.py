@@ -138,7 +138,13 @@ class OtelTracer:
             except asyncio.CancelledError:
                 pass
             self._task = None
-        await self.flush()
+        # drain everything, not just one max_batch slice — shutdown must not
+        # silently discard buffered spans
+        while self._buffer:
+            before = len(self._buffer)
+            await self.flush()
+            if len(self._buffer) >= before:  # collector down: counted as dropped
+                break
         if self._session is not None:
             await self._session.close()
             self._session = None

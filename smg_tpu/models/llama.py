@@ -209,6 +209,7 @@ def forward_prefill(
     attn_impl: str = "xla",  # "xla" | "pallas" | "pallas_interpret" (tests)
     input_embeds: jnp.ndarray | None = None,  # [T, E] mm splice rows
     embeds_mask: jnp.ndarray | None = None,  # [T] bool: row comes from input_embeds
+    pp_mesh=None,  # Mesh: serving pipeline parallelism over the "pp" axis
 ):
     """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache).
 
@@ -217,7 +218,11 @@ def forward_prefill(
     token dim sharded over the ``sp`` mesh axis — KV shards rotate via
     ppermute over ICI instead of every device holding the full chunk.  Only
     valid for COLD chunks (prefix_len==0: the chunk is the entire context);
-    chunks extending a cached prefix use the dense gather path."""
+    chunks extending a cached prefix use the dense gather path.
+
+    ``pp_mesh`` (serving PP, ``parallel/pp_serving.py``): layer stack + KV
+    cache sharded over ``pp``; mutually exclusive with sp/pallas/LoRA (the
+    runner enforces the XLA path)."""
     T = tokens.shape[0]
     if lora is not None:
         lora_gates = jnp.broadcast_to(lora_gates, (T, lora_gates.shape[-1]))
@@ -239,49 +244,66 @@ def forward_prefill(
         # (reference: EPD encode leg shipping embeddings to prefill)
         h = jnp.where(embeds_mask[:, None], input_embeds.astype(h.dtype), h)
 
-    def layer_body(carry, xs):
-        h, k_cache, v_cache = carry
+    def make_body(pos, dest, page_table, ctx_len, inv_freq):
+        """Layer-body factory: pp runs it under shard_map with per-stage
+        consts, the plain path calls it once with the outer tracers."""
+
+        def layer_body(carry, xs):
+            h, k_cache, v_cache = carry
+            if lora is not None:
+                layer, lor, l = xs
+            else:
+                (layer, l), lor = xs, None
+            hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)
+            q = apply_rope(q, pos, inv_freq)
+            k = apply_rope(k, pos, inv_freq)
+            k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
+            if sp_mesh is not None:
+                from smg_tpu.parallel.ring_attention import ring_attention
+
+                attn = ring_attention(q[None], k[None], v[None], sp_mesh, scale)[0]
+            elif attn_impl.startswith("pallas"):
+                # prefix-aware paged kernel: streams only the live prefix pages
+                # instead of gathering the whole mp*ps worst-case context
+                from smg_tpu.ops.pallas.prefill_attention import paged_attention_prefill
+
+                attn = paged_attention_prefill(
+                    q, k.reshape(T, -1), v.reshape(T, -1), k_cache, v_cache, l,
+                    page_table, prefix_len, t_real, scale,
+                    interpret=(attn_impl == "pallas_interpret"),
+                )
+            else:
+                k_ctx, v_ctx = gather_seq_kv(
+                    k_cache[l], v_cache[l], page_table, cfg.num_kv_heads
+                )
+                attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
+            h = h + _attn_out(layer, attn, lor, lora_gates)
+            hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+            h = h + _mlp(layer, hn, cfg)
+            return (h, k_cache, v_cache), None
+
+        return layer_body
+
+    if pp_mesh is not None:
         if lora is not None:
-            layer, lor, l = xs
-        else:
-            (layer, l), lor = xs, None
-        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)
-        q = apply_rope(q, pos, inv_freq)
-        k = apply_rope(k, pos, inv_freq)
-        k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
-        if sp_mesh is not None:
-            from smg_tpu.parallel.ring_attention import ring_attention
+            raise ValueError("LoRA is not supported with serving pp yet")
+        from smg_tpu.parallel.pp_serving import pp_serving_scan
 
-            attn = ring_attention(q[None], k[None], v[None], sp_mesh, scale)[0]
-        elif attn_impl.startswith("pallas"):
-            # prefix-aware paged kernel: streams only the live prefix pages
-            # instead of gathering the whole mp*ps worst-case context
-            from smg_tpu.ops.pallas.prefill_attention import paged_attention_prefill
-
-            attn = paged_attention_prefill(
-                q, k.reshape(T, -1), v.reshape(T, -1), k_cache, v_cache, l,
-                page_table, prefix_len, t_real, scale,
-                interpret=(attn_impl == "pallas_interpret"),
-            )
-        else:
-            k_ctx, v_ctx = gather_seq_kv(
-                k_cache[l], v_cache[l], page_table, cfg.num_kv_heads
-            )
-            attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
-        h = h + _attn_out(layer, attn, lor, lora_gates)
-        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn, cfg)
-        return (h, k_cache, v_cache), None
-
-    xs = (
-        (params["layers"], lora, jnp.arange(cfg.num_layers))
-        if lora is not None
-        else (params["layers"], jnp.arange(cfg.num_layers))
-    )
-    (h, k_cache, v_cache), _ = jax.lax.scan(
-        layer_body, (h, k_cache, v_cache), xs
-    )
+        h, k_cache, v_cache = pp_serving_scan(
+            pp_mesh, make_body, h, k_cache, v_cache, params["layers"],
+            (pos, dest, page_table, ctx_len, inv_freq),
+        )
+    else:
+        xs = (
+            (params["layers"], lora, jnp.arange(cfg.num_layers))
+            if lora is not None
+            else (params["layers"], jnp.arange(cfg.num_layers))
+        )
+        (h, k_cache, v_cache), _ = jax.lax.scan(
+            make_body(pos, dest, page_table, ctx_len, inv_freq),
+            (h, k_cache, v_cache), xs,
+        )
     last = jnp.take_along_axis(
         h, jnp.maximum(t_real - 1, 0)[None, None].astype(jnp.int32), axis=0
     )[0]
@@ -363,6 +385,8 @@ def forward_prefill_batched(
     no_ctx: bool = False,  # static: all rows cold (prefix 0, single chunk)
     lora: Params | None = None,
     lora_gates: jnp.ndarray | None = None,  # [G, N] one-hot per sequence
+    input_embeds: jnp.ndarray | None = None,  # [G, T, E] mm splice rows
+    embeds_mask: jnp.ndarray | None = None,  # [G, T] bool: row from input_embeds
 ):
     """Prefill several sequences in one device call (fills the MXU and
     amortizes dispatch; single-sequence prefill wastes both).  Returns
@@ -386,6 +410,10 @@ def forward_prefill_batched(
     ctx_lens = prefix_lens + t_reals
 
     h = embed_tokens(params, cfg, tokens)  # [G, T, E]
+    if input_embeds is not None:
+        # mm splice: placeholder rows take vision-tower embeddings
+        # (reference: the EPD encode leg's output entering prefill)
+        h = jnp.where(embeds_mask[:, :, None], input_embeds.astype(h.dtype), h)
     if lora is not None:
         # per-sequence gate broadcast across the row's tokens
         lora_gates = jnp.broadcast_to(
@@ -452,6 +480,7 @@ def forward_decode_horizon(
     attn_impl: str = "xla",
     lora: Params | None = None,
     lora_gates: jnp.ndarray | None = None,  # [B, n_adapters] one-hot per slot
+    pp_mesh=None,  # Mesh: serving pipeline parallelism over the "pp" axis
 ):
     """One decode step against a frozen cache + growing side buffer.
 
@@ -460,6 +489,9 @@ def forward_decode_horizon(
     ``decode_multi`` call (see ``smg_tpu/ops/pallas/decode_attention.py``
     module docs for why the cache must not be updated inside the loop).
     Returns (logits [B, V], hk_all, hv_all).
+
+    Under ``pp_mesh`` the layer stack, the frozen cache, and the side
+    buffers shard their layer axis over ``pp`` (``parallel/pp_serving.py``).
     """
     scale = 1.0 / math.sqrt(cfg.head_dim)
     K, D = cfg.num_kv_heads, cfg.head_dim
@@ -467,51 +499,68 @@ def forward_decode_horizon(
 
     h = embed_tokens(params, cfg, tokens)  # [B, E]
 
-    def layer_body(carry, xs):
-        h, hk_all, hv_all = carry
+    def make_body(positions, step_idx, entry_positions, page_tables, inv_freq,
+                  k_cache, v_cache):
+        def layer_body(carry, xs):
+            h, hk_all, hv_all = carry
+            if lora is not None:
+                layer, lor, l = xs
+            else:
+                (layer, l), lor = xs, None
+            hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [B, H/K, D]
+            q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+            k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+            k_f = k.reshape(B, K * D).astype(hk_all.dtype)
+            v_f = v.reshape(B, K * D).astype(hv_all.dtype)
+            hk_all = jax.lax.dynamic_update_slice(
+                hk_all, k_f[None, :, None, :], (l, 0, step_idx, 0)
+            )
+            hv_all = jax.lax.dynamic_update_slice(
+                hv_all, v_f[None, :, None, :], (l, 0, step_idx, 0)
+            )
+            hk_l = jax.lax.dynamic_index_in_dim(hk_all, l, 0, keepdims=False)
+            hv_l = jax.lax.dynamic_index_in_dim(hv_all, l, 0, keepdims=False)
+            if attn_impl == "pallas":
+                from smg_tpu.ops.pallas.decode_attention import paged_attention_decode_cached
+
+                attn = paged_attention_decode_cached(
+                    q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
+                    page_tables, entry_positions, scale,
+                )
+            else:
+                attn = attention_decode_cached(
+                    q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
+                    page_tables, entry_positions, scale,
+                )
+            h = h + _attn_out(layer, attn, lor, lora_gates)
+            hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+            h = h + _mlp(layer, hn, cfg)
+            return (h, hk_all, hv_all), None
+
+        return layer_body
+
+    if pp_mesh is not None:
         if lora is not None:
-            layer, lor, l = xs
-        else:
-            (layer, l), lor = xs, None
-        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [B, H/K, D]
-        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        k_f = k.reshape(B, K * D).astype(hk_all.dtype)
-        v_f = v.reshape(B, K * D).astype(hv_all.dtype)
-        hk_all = jax.lax.dynamic_update_slice(
-            hk_all, k_f[None, :, None, :], (l, 0, step_idx, 0)
-        )
-        hv_all = jax.lax.dynamic_update_slice(
-            hv_all, v_f[None, :, None, :], (l, 0, step_idx, 0)
-        )
-        hk_l = jax.lax.dynamic_index_in_dim(hk_all, l, 0, keepdims=False)
-        hv_l = jax.lax.dynamic_index_in_dim(hv_all, l, 0, keepdims=False)
-        if attn_impl == "pallas":
-            from smg_tpu.ops.pallas.decode_attention import paged_attention_decode_cached
+            raise ValueError("LoRA is not supported with serving pp yet")
+        from smg_tpu.parallel.pp_serving import pp_decode_scan
 
-            attn = paged_attention_decode_cached(
-                q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
-                page_tables, entry_positions, scale,
-            )
-        else:
-            attn = attention_decode_cached(
-                q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
-                page_tables, entry_positions, scale,
-            )
-        h = h + _attn_out(layer, attn, lor, lora_gates)
-        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn, cfg)
-        return (h, hk_all, hv_all), None
-
-    xs = (
-        (params["layers"], lora, jnp.arange(cfg.num_layers))
-        if lora is not None
-        else (params["layers"], jnp.arange(cfg.num_layers))
-    )
-    (h, hk_all, hv_all), _ = jax.lax.scan(
-        layer_body, (h, hk_all, hv_all), xs
-    )
+        h, hk_all, hv_all = pp_decode_scan(
+            pp_mesh, make_body, h, hk_all, hv_all, k_cache, v_cache,
+            params["layers"],
+            (positions, step_idx, entry_positions, page_tables, inv_freq),
+        )
+    else:
+        xs = (
+            (params["layers"], lora, jnp.arange(cfg.num_layers))
+            if lora is not None
+            else (params["layers"], jnp.arange(cfg.num_layers))
+        )
+        (h, hk_all, hv_all), _ = jax.lax.scan(
+            make_body(positions, step_idx, entry_positions, page_tables,
+                      inv_freq, k_cache, v_cache),
+            (h, hk_all, hv_all), xs,
+        )
     logits = unembed(params, cfg, h)
     return logits, hk_all, hv_all
 

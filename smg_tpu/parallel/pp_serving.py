@@ -1,0 +1,143 @@
+"""Serving-side pipeline parallelism: pp-sharded layer stacks in the
+prefill/decode forwards.
+
+VERDICT r2/r3 gap: ``pipeline_apply`` pipelined TRAINING microbatches only —
+serving never used the ``pp`` axis, so models that don't fit TP-only on a
+slice could not be served.  This module closes that: the per-layer parameter
+stack AND the KV cache shard their layer axis over ``pp`` (each stage holds
+``L/S`` layers' weights and KV), and the serving layer scan runs as a
+sequential SPMD schedule under ``jax.shard_map`` manual over ``pp`` only —
+tp/dp/sp/ep stay under GSPMD inside the stage body, exactly like
+``pipeline_apply``.
+
+Schedule (capacity-first, single in-flight item): S ticks; at tick ``s``
+stage ``s`` runs its local layers on the activations received from stage
+``s-1``, then hands them over ``ppermute`` (neighbor ICI/DCN links).  Other
+stages compute on stale data and discard the result (the standard SPMD idle
+trade — with one microbatch the pipeline is sequential; PP here buys HBM
+capacity, not latency).  The final activations land on stage 0 after the
+last hop and are psum-broadcast for the replicated unembed.
+
+State (KV cache / horizon side buffers) is kept only on the owning tick, so
+off-turn garbage compute never corrupts a stage's shard.
+
+Not composed with LoRA or the Pallas/ring attention variants in v1 — the
+runner forces the XLA attention path and rejects adapters under pp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pp_serving_scan(
+    mesh,
+    make_body,
+    h: jnp.ndarray,            # replicated activations entering the stack
+    s1, s2,                    # layer-stacked state (KV cache / side buffers),
+                               # leading dim = L, sharded over ``axis``
+    layers,                    # pytree, leading dim = L
+    consts: tuple,             # replicated arrays the body closes over
+    axis: str = "pp",
+):
+    """Run ``make_body(*consts)``'s layer body over a pp-sharded stack.
+
+    ``make_body(*consts) -> body`` where ``body((h, s1, s2), (layer, l))``
+    is a standard ``lax.scan`` layer step; ``l`` is the LOCAL layer index
+    into the stage's state shard.  Returns (h, s1, s2) with ``h``
+    replicated and state still sharded.
+    """
+    S = mesh.shape[axis]
+    L = jax.tree.leaves(layers)[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"num_layers {L} not divisible by pp={S}")
+
+    def run(h, s1, s2, layers_local, consts):
+        body = make_body(*consts)
+        L_local = jax.tree.leaves(layers_local)[0].shape[0]
+        stage = jax.lax.axis_index(axis)
+        xs = (layers_local, jnp.arange(L_local))
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, s):
+            h, s1, s2 = carry
+            (h2, s1n, s2n), _ = jax.lax.scan(body, (h, s1, s2), xs)
+            my = s == stage
+            h2 = jnp.where(my, h2, h)
+            s1n = jnp.where(my, s1n, s1)
+            s2n = jnp.where(my, s2n, s2)
+            h2 = jax.lax.ppermute(h2, axis, perm)
+            return (h2, s1n, s2n), None
+
+        (h, s1, s2), _ = jax.lax.scan(tick, (h, s1, s2), jnp.arange(S))
+        # the last hop parked stage S-1's final output on stage 0
+        h = jax.lax.psum(jnp.where(stage == 0, h, jnp.zeros_like(h)), axis)
+        return h, s1, s2
+
+    layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    const_specs = jax.tree.map(lambda _: P(), consts)
+    fn = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), layer_specs, const_specs),
+        out_specs=(P(), P(axis), P(axis)),
+        axis_names={axis},
+        check_vma=False,
+    )
+    return fn(h, s1, s2, layers, consts)
+
+
+def pp_decode_scan(
+    mesh,
+    make_body,
+    h: jnp.ndarray,
+    hk, hv,                    # [L, B, N, KD] horizon side buffers (pp on L)
+    k_cache, v_cache,          # [L, P, ps, KD] frozen cache (pp on L, read-only)
+    layers,
+    consts: tuple,
+    axis: str = "pp",
+):
+    """Decode-horizon variant of :func:`pp_serving_scan`: the frozen KV
+    cache enters each stage as a LOCAL read-only shard (it is already
+    pp-sharded on its layer axis) and the body factory receives it last:
+    ``make_body(*consts, k_cache_local, v_cache_local)``."""
+    S = mesh.shape[axis]
+    L = jax.tree.leaves(layers)[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"num_layers {L} not divisible by pp={S}")
+
+    def run(h, hk, hv, kc, vc, layers_local, consts):
+        body = make_body(*consts, kc, vc)
+        L_local = jax.tree.leaves(layers_local)[0].shape[0]
+        stage = jax.lax.axis_index(axis)
+        xs = (layers_local, jnp.arange(L_local))
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, s):
+            h, hk, hv = carry
+            (h2, hk2, hv2), _ = jax.lax.scan(body, (h, hk, hv), xs)
+            my = s == stage
+            h2 = jnp.where(my, h2, h)
+            hk2 = jnp.where(my, hk2, hk)
+            hv2 = jnp.where(my, hv2, hv)
+            h2 = jax.lax.ppermute(h2, axis, perm)
+            return (h2, hk2, hv2), None
+
+        (h, hk, hv), _ = jax.lax.scan(tick, (h, hk, hv), jnp.arange(S))
+        h = jax.lax.psum(jnp.where(stage == 0, h, jnp.zeros_like(h)), axis)
+        return h, hk, hv
+
+    layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    const_specs = jax.tree.map(lambda _: P(), consts)
+    fn = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), layer_specs,
+                  const_specs),
+        out_specs=(P(), P(axis), P(axis)),
+        axis_names={axis},
+        check_vma=False,
+    )
+    return fn(h, hk, hv, k_cache, v_cache, layers, consts)

@@ -79,6 +79,13 @@ class PostgresStorage(ConversationStorage, ConversationItemStorage, ResponseStor
             await self.client.query(
                 f"INSERT INTO smg_migrations VALUES ({i}, {time.time()})"
             )
+        # resume the item sequence where the table left off — a fresh
+        # process-local counter would interleave new turns into old history
+        # after a restart (and collide across gateway instances)
+        rows = await self.client.query(
+            "SELECT COALESCE(MAX(seq), 0) AS s FROM conversation_items"
+        )
+        self._seq = max(self._seq, int(rows[0]["s"] or 0))
         self._migrated = True
 
     async def close(self) -> None:
@@ -131,8 +138,9 @@ class PostgresStorage(ConversationStorage, ConversationItemStorage, ResponseStor
 
     async def list_conversations(self, limit: int = 100) -> list[Conversation]:
         await self._ensure()
+        # newest first: parity with the memory/sqlite backends
         rows = await self.client.query(
-            f"SELECT * FROM conversations ORDER BY created_at LIMIT {int(limit)}"
+            f"SELECT * FROM conversations ORDER BY created_at DESC LIMIT {int(limit)}"
         )
         return [
             Conversation(id=r["id"], created_at=float(r["created_at"]),

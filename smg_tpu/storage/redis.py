@@ -95,8 +95,9 @@ class RedisStorage(ConversationStorage, ConversationItemStorage, ResponseStorage
         return bool(self._check(replies[0]))
 
     async def list_conversations(self, limit: int = 100) -> list[Conversation]:
+        # newest first: parity with the memory/sqlite backends
         ids = self._check(await self.client.command(
-            "ZRANGE", self._k("convs"), 0, limit - 1
+            "ZREVRANGE", self._k("convs"), 0, limit - 1
         )) or []
         if not ids:
             return []

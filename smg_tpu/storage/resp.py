@@ -134,12 +134,20 @@ class RespClient:
         async with self._lock:
             if self._writer is None:  # dial inside the lock: no connect race
                 await self._connect_locked()
-            self._writer.write(b"".join(self.encode(c) for c in commands))
-            await self._writer.drain()
-            replies = []
-            for _ in commands:
-                try:
-                    replies.append(await self._read_reply())
-                except RespError as e:
-                    replies.append(e)
-            return replies
+            try:
+                self._writer.write(b"".join(self.encode(c) for c in commands))
+                await self._writer.drain()
+                replies = []
+                for _ in commands:
+                    try:
+                        replies.append(await self._read_reply())
+                    except RespError as e:
+                        replies.append(e)
+                return replies
+            except (OSError, asyncio.IncompleteReadError, ConnectionError):
+                # dead/desynced socket: drop it so the next call re-dials
+                # instead of poisoning every future command
+                writer, self._reader, self._writer = self._writer, None, None
+                if writer is not None:
+                    writer.close()
+                raise

@@ -41,6 +41,11 @@ class HFTokenizer:
         self._special_ids = {
             tid for tid, tok in enumerate_added_special(self._tok)
         }
+        #: special-token strings — atomic in BPE, the safe L1 prefix-cache
+        #: boundaries (reference: cache/l1.rs)
+        self.all_special_tokens = [
+            tok for _, tok in enumerate_added_special(self._tok)
+        ]
 
     def _load_chat_template(self, dirname: str) -> str | None:
         jinja_file = os.path.join(dirname, "chat_template.jinja")

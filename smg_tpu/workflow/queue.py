@@ -65,12 +65,18 @@ class JobQueue:
         job = Job(fn=fn, name=name)
         self._jobs[job.job_id] = job
         self._order.append(job.job_id)
+        # trim history: evict only TERMINAL jobs (a live job must stay both
+        # listed and tracked); stop at the first live one to keep order
         while len(self._order) > self._history:
-            old = self._order.pop(0)
-            if self._jobs.get(old) is not None and self._jobs[old].status in (
+            old = self._order[0]
+            old_job = self._jobs.get(old)
+            if old_job is not None and old_job.status not in (
                 "succeeded", "failed", "cancelled"
             ):
-                self._jobs.pop(old, None)
+                break
+            self._order.pop(0)
+            self._jobs.pop(old, None)
+            self._done_events.pop(old, None)
         self._done_events[job.job_id] = asyncio.Event()
         try:
             self._queue.put_nowait(job)

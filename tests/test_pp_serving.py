@@ -1,0 +1,104 @@
+"""Serving-side pipeline parallelism (VERDICT r3 next-round #7): layer stack
++ KV cache sharded over the pp mesh axis; prefill (incl. chunked) and the
+decode horizon run through the sequential SPMD pp schedule
+(``parallel/pp_serving.py``) — token-exact vs single device."""
+
+import numpy as np
+import pytest
+
+from smg_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+)
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def _engine(parallel, devs):
+    cfg = EngineConfig(
+        model=tiny_test_config(),  # 4 layers: divisible by pp=2 and pp=4
+        parallel=parallel,
+        cache=CacheConfig(page_size=16, num_pages=96, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(32, 64), decode_batch_buckets=(4,),
+        ),
+        dtype="float32",
+    )
+    return Engine(cfg, tokenizer=MockTokenizer(), devices=devs)
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pp_serving_matches_single(cpu_devices, pp):
+    nl = tiny_test_config().num_layers
+    if nl % pp:
+        pytest.skip(f"{nl} layers not divisible by pp={pp}")
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=10, ignore_eos=True)
+    prompt = [(i * 5) % 90 + 7 for i in range(30)]
+    single = _engine(ParallelConfig(), cpu_devices[:1])
+    try:
+        want = single.generate(prompt_ids=prompt, sampling=sampling)
+    finally:
+        single.stop()
+    pp_eng = _engine(ParallelConfig(pp=pp), cpu_devices[:pp])
+    try:
+        got = pp_eng.generate(prompt_ids=prompt, sampling=sampling)
+        # params + KV cache actually sharded over pp (capacity claim)
+        import jax
+
+        kv_spec = pp_eng.runner.k_cache.sharding.spec
+        assert kv_spec[0] == "pp", kv_spec
+        layer_leaf = jax.tree.leaves(pp_eng.runner.params["layers"])[0]
+        assert layer_leaf.sharding.spec[0] == "pp"
+    finally:
+        pp_eng.stop()
+    assert got.token_ids == want.token_ids
+
+
+def test_pp_serving_chunked_prefill_matches_single(cpu_devices):
+    """Prompt longer than max_prefill_tokens: warm chunks extend the cache
+    through the pp schedule."""
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=8, ignore_eos=True)
+    prompt = [(i * 7) % 90 + 5 for i in range(100)]  # chunks of 64 + 36
+    single = _engine(ParallelConfig(), cpu_devices[:1])
+    try:
+        want = single.generate(prompt_ids=prompt, sampling=sampling)
+    finally:
+        single.stop()
+    pp_eng = _engine(ParallelConfig(pp=2), cpu_devices[:2])
+    try:
+        got = pp_eng.generate(prompt_ids=prompt, sampling=sampling)
+    finally:
+        pp_eng.stop()
+    assert got.token_ids == want.token_ids
+
+
+def test_pp_composes_with_tp(cpu_devices):
+    """pp x tp: manual over pp only, tp stays GSPMD inside the stage."""
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=6, ignore_eos=True)
+    prompt = [(i * 3) % 90 + 5 for i in range(20)]
+    single = _engine(ParallelConfig(), cpu_devices[:1])
+    try:
+        want = single.generate(prompt_ids=prompt, sampling=sampling)
+    finally:
+        single.stop()
+    eng = _engine(ParallelConfig(pp=2, tp=2), cpu_devices[:4])
+    try:
+        got = eng.generate(prompt_ids=prompt, sampling=sampling)
+    finally:
+        eng.stop()
+    assert got.token_ids == want.token_ids
+
+
+def test_pp_rejects_lora(cpu_devices):
+    eng = _engine(ParallelConfig(pp=2), cpu_devices[:2])
+    try:
+        with pytest.raises(ValueError, match="serving pp"):
+            eng.runner.load_lora("a", {})
+    finally:
+        eng.stop()

@@ -1,0 +1,262 @@
+"""Client SDK (generated from openapi.json) + tokenizer tiers: tiktoken BPE
+and the L1 prefix cache (VERDICT r3 next-round #10)."""
+
+import asyncio
+import base64
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "clients", "python"))
+
+
+# ---- generated SDK ----
+
+
+def test_sdk_no_drift():
+    """The checked-in client matches a fresh generation from openapi.json."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import json
+
+    import gen_client
+
+    spec = json.load(open(os.path.join(os.path.dirname(__file__), "..",
+                                       "openapi.json")))
+    fresh = gen_client.generate(spec)
+    checked_in = open(os.path.join(os.path.dirname(__file__), "..",
+                                   "clients", "python", "smg_client.py")).read()
+    assert fresh == checked_in, "run scripts/gen_client.py to refresh the SDK"
+
+
+@pytest.fixture(scope="module")
+def live_gateway():
+    """Real aiohttp server on a TCP port (the stdlib-urllib SDK needs one)."""
+    from aiohttp import web
+
+    from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+    from smg_tpu.engine.engine import Engine
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.worker_client import InProcWorkerClient
+    from smg_tpu.gateway.workers import Worker
+    from smg_tpu.models.config import tiny_test_config
+    from smg_tpu.tokenizer import MockTokenizer
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=300):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    eng = Engine(EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=2, max_seq_len=128, max_prefill_tokens=32,
+            prefill_token_buckets=(32,), decode_batch_buckets=(2,),
+        ),
+        dtype="float32", model_id="tiny-sdk",
+    ), tokenizer=MockTokenizer())
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-sdk", MockTokenizer(), default=True)
+
+    async def _setup():
+        ctx.registry.add(Worker(worker_id="w0", client=InProcWorkerClient(eng),
+                                model_id="tiny-sdk"))
+        runner = web.AppRunner(build_app(ctx))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner, site._server.sockets[0].getsockname()[1]
+
+    runner, port = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.base_url = f"http://127.0.0.1:{port}"
+    yield h
+    run(runner.cleanup())
+    loop.call_soon_threadsafe(loop.stop)
+    eng.stop()
+
+
+def test_sdk_smoke_against_gateway(live_gateway):
+    from smg_client import ApiError, SmgClient
+
+    c = SmgClient(live_gateway.base_url)
+    assert c.health()["status"] == "ok"
+    models = c.list_models()
+    assert models["data"][0]["id"] == "tiny-sdk"
+    r = c.chat({
+        "model": "tiny-sdk",
+        "messages": [{"role": "user", "content": "w5 w6"}],
+        "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+    })
+    assert r["usage"]["completion_tokens"] == 4
+    # streaming yields parsed chunks
+    chunks = list(c.chat({
+        "model": "tiny-sdk",
+        "messages": [{"role": "user", "content": "w5"}],
+        "max_tokens": 3, "temperature": 0, "ignore_eos": True,
+        "stream": True,
+    }))
+    assert len(chunks) >= 3
+    assert all("choices" in ch for ch in chunks)
+    # errors surface as ApiError with parsed body
+    with pytest.raises(ApiError) as exc:
+        c.chat({"model": "tiny-sdk", "messages": "nonsense"})
+    assert exc.value.status == 400
+    assert c.list_workers()["workers"][0]["worker_id"] == "w0"
+
+
+# ---- tiktoken BPE ----
+
+
+TINY_RANKS = {
+    b"h": 0, b"e": 1, b"l": 2, b"o": 3, b" ": 4, b"w": 5, b"r": 6, b"d": 7,
+    b"he": 8, b"ll": 9, b"llo": 10, b"hello": 11, b" w": 12, b"or": 13,
+    b"ord": 14, b"!": 15, b"a": 16, b"b": 17, b"c": 18,
+}
+
+
+@pytest.fixture()
+def ranks_file(tmp_path):
+    p = tmp_path / "tiny.tiktoken"
+    with open(p, "wb") as f:
+        for tok, rank in TINY_RANKS.items():
+            f.write(base64.b64encode(tok) + b" " + str(rank).encode() + b"\n")
+    return str(p)
+
+
+def test_tiktoken_bpe_merge_order(ranks_file):
+    from smg_tpu.tokenizer.tiktoken import TiktokenTokenizer, bpe_merge
+
+    tok = TiktokenTokenizer(ranks_file,
+                            special_tokens={"<|endoftext|>": 100})
+    # "hello" merges all the way to its own token (rank 11)
+    assert bpe_merge(b"hello", tok.ranks) == [11]
+    # merge priority: "he" (8) before "ll"? both exist — lowest rank first.
+    # "held" -> h e l d: best pair "he"(8); then "he"+"l"? absent; "l"+"d"?
+    # absent -> [8, 2, 7]
+    assert bpe_merge(b"held", tok.ranks) == [8, 2, 7]
+    ids = tok.encode("hello world!")
+    assert tok.decode(ids) == "hello world!"
+
+
+def test_tiktoken_special_tokens_atomic(ranks_file):
+    from smg_tpu.tokenizer.tiktoken import TiktokenTokenizer
+
+    tok = TiktokenTokenizer(ranks_file,
+                            special_tokens={"<|endoftext|>": 100,
+                                            "<|sep|>": 101})
+    ids = tok.encode("hello<|sep|>world")
+    assert 101 in ids
+    i = ids.index(101)
+    assert tok.decode(ids[:i]) == "hello"
+    assert tok.decode(ids[i + 1:]) == "world"
+    # skip_special_tokens drops them on decode
+    assert tok.decode(ids) == "helloworld"
+    assert tok.decode(ids, skip_special_tokens=False) == "hello<|sep|>world"
+    # splice guarantee at the special boundary (the L1 precondition)
+    pre, post = "hello<|sep|>", "world"
+    assert tok.encode(pre) + tok.encode(post) == tok.encode(pre + post)
+
+
+def test_tiktoken_unknown_bytes_raise(ranks_file):
+    from smg_tpu.tokenizer.tiktoken import TiktokenTokenizer
+
+    tok = TiktokenTokenizer(ranks_file)
+    with pytest.raises(ValueError):
+        tok.encode("zzz")  # 'z' not in the tiny vocab
+
+
+# ---- L1 prefix cache ----
+
+
+class SpecialMock:
+    """Mock tokenizer with atomic special 'tokens' (whitespace-separated
+    words; any whitespace boundary splices exactly, so special-token
+    boundaries — which MockTokenizer-style vocab places after a space —
+    satisfy the L1 guarantee)."""
+
+    all_special_tokens = ["<|im_end|>"]
+
+    def __init__(self):
+        self.encode_calls = []
+
+    def encode(self, text, add_special_tokens=False):
+        self.encode_calls.append(text)
+        out = []
+        for w in text.split():
+            out.append(hash(w) % 1000)
+        return out
+
+
+def test_l1_boundaries():
+    from smg_tpu.tokenizer.cache import find_boundaries
+
+    text = "a<|im_end|>b<|im_end|>c"
+    ends = find_boundaries(text, ["<|im_end|>"])
+    assert ends == [len("a<|im_end|>"), len("a<|im_end|>b<|im_end|>")]
+    assert find_boundaries(text, []) == []
+
+
+def test_l1_hit_path_reuses_prefix():
+    from smg_tpu.tokenizer.cache import L1PrefixCache
+
+    tok = SpecialMock()
+    l1 = L1PrefixCache(tok.all_special_tokens, min_prefix_chars=4)
+    sys_prefix = "system long shared prompt <|im_end|> "
+    t1 = sys_prefix + "user question one"
+    t2 = sys_prefix + "different user words"
+    full1 = tok.encode(t1)
+    l1.seed(t1, tok.encode, full_ids=full1)
+    hit = l1.lookup(t2)
+    assert hit is not None
+    prefix_ids, end = hit
+    assert end <= len(sys_prefix)  # boundary sits right after <|im_end|>
+    spliced = prefix_ids + tok.encode(t2[end:])
+    assert spliced == tok.encode(t2)
+
+
+def test_l1_poison_on_unsafe_tokenizer():
+    """A tokenizer whose splice equality fails disables the cache."""
+    from smg_tpu.tokenizer.cache import L1PrefixCache
+
+    class Unsafe:
+        all_special_tokens = ["<|x|>"]
+
+        def encode(self, text, add_special_tokens=False):
+            # length-dependent tokenization: splicing never matches
+            return [len(text)]
+
+    tok = Unsafe()
+    l1 = L1PrefixCache(tok.all_special_tokens, min_prefix_chars=1)
+    text = "aaa<|x|>bbb"
+    l1.seed(text, tok.encode, full_ids=tok.encode(text))
+    assert not l1.active
+    assert l1.lookup(text) is None
+
+
+def test_registry_l1_integration():
+    from smg_tpu.tokenizer.registry import TokenizerRegistry
+
+    tok = SpecialMock()
+    reg = TokenizerRegistry()
+    reg.register("m", tok, default=True)
+    sys_prefix = "shared system prompt <|im_end|> "
+    a = reg.encode_cached("m", sys_prefix + "alpha beta")
+    # second text shares the prefix: the L1 hit must only encode the suffix
+    tok.encode_calls.clear()
+    b = reg.encode_cached("m", sys_prefix + "gamma delta epsilon")
+    # the encode calls during the cached lookup never include the full text
+    joined = [c for c in tok.encode_calls if sys_prefix in c and "gamma" in c]
+    assert not joined, tok.encode_calls
+    assert b == tok.encode(sys_prefix + "gamma delta epsilon")
+    l1 = reg._l1_for(tok)
+    assert l1.stats()["hits"] >= 1

@@ -49,8 +49,10 @@ class FakeRedis:
             return 1
         if cmd == "ZREM":
             return int(self.zsets.get(a[0], {}).pop(a[1], None) is not None)
-        if cmd == "ZRANGE":
+        if cmd in ("ZRANGE", "ZREVRANGE"):
             members = sorted(self.zsets.get(a[0], {}).items(), key=lambda kv: kv[1])
+            if cmd == "ZREVRANGE":
+                members = members[::-1]
             lo, hi = int(a[1]), int(a[2])
             hi = len(members) if hi == -1 else hi + 1
             return [m.encode() for m, _ in members[lo:hi]]

@@ -148,9 +148,9 @@ def test_mm_splice_parity_chunked(vlm):
     assert got == want
 
 
-def test_mm_requests_bypass_radix_cache(vlm):
+def test_mm_radix_content_keys_no_aliasing(vlm):
     """Two mm requests with identical token ids but different embeds must not
-    share cached prefix state."""
+    share cached prefix state (content-hash extra keys, not cache bypass)."""
     table = np.asarray(vlm.runner.params["embed"], np.float32)
     pad = vlm.config.model.image_token_id
     prompt = [5, 6] + [pad] * 4 + list(range(30, 38))
@@ -161,6 +161,47 @@ def test_mm_requests_bypass_radix_cache(vlm):
     a_want = _generate(vlm, [5, 6, 11, 12, 13, 14] + list(range(30, 38)))
     b_want = _generate(vlm, [5, 6, 15, 16, 17, 18] + list(range(30, 38)))
     assert a == a_want and b == b_want
+
+
+def test_mm_radix_cache_shares_same_image(vlm):
+    """Repeating the SAME image prompt hits the radix cache (r3 weak #6:
+    mm requests used to bypass caching entirely) and still generates the
+    same tokens as the first pass."""
+    table = np.asarray(vlm.runner.params["embed"], np.float32)
+    pad = vlm.config.model.image_token_id
+    # long enough that full pages (ps=16) land in the tree
+    prompt = list(range(40, 56)) + [pad] * 8 + list(range(60, 70))
+    positions = np.arange(16, 24)
+    mm = (table[[11, 12, 13, 14, 15, 16, 17, 18]], positions)
+    first = _generate(vlm, prompt, mm=mm)
+
+    cached_seen = {}
+    done = {}
+
+    def cb(out):
+        cached_seen["n"] = out.cached_tokens
+        if out.finished:
+            done["ids"] = True
+
+    from smg_tpu.protocols.sampling import SamplingParams as SP
+
+    acc = []
+
+    def cb2(out):
+        cached_seen["n"] = max(cached_seen.get("n", 0), out.cached_tokens)
+        acc.extend(out.new_token_ids)
+        if out.finished:
+            done["ids"] = list(acc)
+
+    vlm.submit(prompt, SP(temperature=0.0, max_new_tokens=8, ignore_eos=True),
+               rid="mm-cache-hit", on_output=cb2, mm_embeds=mm)
+    for _ in range(200):
+        vlm.step()
+        if "ids" in done:
+            break
+    assert done["ids"] == first
+    # the shared prefix (first full pages incl. mm-salted ones) was reused
+    assert cached_seen["n"] >= 16
 
 
 def test_hf_config_parses_vision():
