@@ -46,7 +46,42 @@ class DeviceKvConnector:
         runner.import_pages_device(pages, k, v)
 
 
-_CONNECTORS = {c.name: c for c in (HostKvConnector(), DeviceKvConnector())}
+class TransferKvConnector:
+    """Cross-host device pull via ``jax.experimental.transfer``
+    (``engine/kv_transfer.py``): export gathers pages on-device and OFFERS
+    them under a uuid on the engine's TransferServer; only the
+    (address, uuid, shape, dtype) descriptor crosses the gRPC control
+    channel, and the decode worker pulls the bulk bytes device-to-device."""
+
+    name = "transfer"
+
+    def export(self, runner, pages: list[int]):
+        k, v = runner.export_pages_device(pages)
+        mgr = runner.kv_transfer
+        uuid = mgr.offer([k, v])
+        descriptor = {
+            "transfer_address": mgr.address,
+            "transfer_uuid": uuid,
+            "kv_shape": tuple(k.shape),
+            "kv_dtype": str(k.dtype),
+        }
+        return descriptor, descriptor  # (k-slot, v-slot): metadata only
+
+    def import_(self, runner, pages: list[int], k, v) -> None:
+        """``k`` is the descriptor dict from ``export``."""
+        desc = k
+        shape, dtype = tuple(desc["kv_shape"]), desc["kv_dtype"]
+        kk, vv = runner.kv_transfer.pull(
+            desc["transfer_address"], int(desc["transfer_uuid"]),
+            [(shape, dtype), (shape, dtype)],
+        )
+        runner.import_pages_device(pages, kk, vv)
+
+
+_CONNECTORS = {
+    c.name: c
+    for c in (HostKvConnector(), DeviceKvConnector(), TransferKvConnector())
+}
 
 
 def get_connector(name: str):
@@ -60,8 +95,9 @@ def get_connector(name: str):
 
 def resolve_for_payload(k):
     """Connector that can land a given KV payload (single owner of the
-    payload-type knowledge; future cross-host transfer payloads dispatch
-    here too)."""
+    payload-type knowledge)."""
     import jax
 
+    if isinstance(k, dict) and "transfer_address" in k:
+        return _CONNECTORS["transfer"]
     return _CONNECTORS["device" if isinstance(k, jax.Array) else "host"]

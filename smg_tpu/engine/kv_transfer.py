@@ -1,0 +1,154 @@
+"""Cross-host device-to-device KV transfer (PD disaggregation leg 3).
+
+Reference: the NIXL/Mooncake RDMA connectors
+(``routers/grpc/common/stages/request_execution.rs:34-82``) move prompt KV
+between prefill and decode workers without staging on the host.  The
+TPU-native equivalent is ``jax.experimental.transfer``: each worker runs a
+TransferServer bound to its PJRT client; the prefill side *offers* the
+gathered KV arrays under a uuid, the decode side *pulls* them directly into
+its own device memory over the transfer transport (DCN between hosts).  Only
+uuid+address+shape ride the gRPC control channel — the bulk bytes never
+touch either Python process.
+
+Scope: one device per engine leg (the standard PD pair).  Sharded
+multi-device payloads still use the single-controller ``device`` connector
+(``jax.device_put`` across meshes) or the ``host`` fallback; a
+multi-controller sharded pull needs per-shard offers, which is future work.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("engine.kv_transfer")
+
+
+def transfer_available() -> bool:
+    try:
+        from jax.experimental import transfer  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class TransferManager:
+    """One per engine: lazy TransferServer + uuid allocation + pull client.
+
+    Bind address comes from ``SMG_TRANSFER_BIND`` (default ``127.0.0.1:0``;
+    set to the host's routable IP for cross-host deployments)."""
+
+    #: seconds an un-pulled offer may live before being reclaimed
+    OFFER_TTL = 120.0
+
+    def __init__(self, device):
+        self._device = device
+        self._server = None
+        # RLock: pull() holds it across the server-property access
+        self._lock = threading.RLock()
+        self._next_uuid = int.from_bytes(os.urandom(6), "little") << 16
+        self._connections: dict[str, object] = {}
+        # uuid -> (deadline, [(shape, dtype), ...]) for orphan reclamation
+        self._pending: dict[int, tuple] = {}
+
+    @property
+    def server(self):
+        with self._lock:
+            if self._server is None:
+                from jax.experimental import transfer
+
+                bind = os.environ.get("SMG_TRANSFER_BIND", "127.0.0.1:0")
+                # transport address carries the bulk stream; same interface
+                self._server = transfer.start_transfer_server(
+                    self._device.client, bind, [bind]
+                )
+                logger.info("kv transfer server on %s", self._server.address())
+            return self._server
+
+    @property
+    def address(self) -> str:
+        return self.server.address()
+
+    def offer(self, arrays: list) -> int:
+        """Register arrays for a one-shot remote pull; returns the uuid.
+
+        A registered offer pins its arrays in device memory until pulled,
+        and the transfer API has no cancel — so offers are tracked and the
+        decode outcome is signalled back (``mark_consumed`` on success,
+        ``reclaim`` on failure: the failure path SELF-pulls the offer into
+        a discarded buffer, which is the only way to make the server
+        release it).  A TTL reap backstops requests whose router died
+        before signalling either way."""
+        import time
+
+        self._reap()
+        with self._lock:
+            self._next_uuid += 1
+            uuid = self._next_uuid
+            self._pending[uuid] = (
+                time.monotonic() + self.OFFER_TTL,
+                [(tuple(a.shape), str(a.dtype)) for a in arrays],
+            )
+        self.server.await_pull(uuid, arrays)
+        return uuid
+
+    def mark_consumed(self, uuid: int) -> bool:
+        """The decode leg pulled this offer — stop tracking it."""
+        with self._lock:
+            return self._pending.pop(uuid, None) is not None
+
+    def reclaim(self, uuid: int) -> bool:
+        """The decode leg failed before pulling: consume our own offer so
+        the server releases the pinned arrays.  Runs in a daemon thread —
+        if the decode leg DID pull concurrently (rare race) the self-pull
+        of a consumed uuid blocks forever, and a wedged daemon thread is
+        the contained failure mode."""
+        with self._lock:
+            entry = self._pending.pop(uuid, None)
+        if entry is None:
+            return False
+        _, specs = entry
+        addr = self.address
+
+        def drain():
+            try:
+                self.pull(addr, uuid, specs)
+                logger.info("reclaimed abandoned kv offer %d", uuid)
+            except Exception:
+                logger.exception("failed to reclaim kv offer %d", uuid)
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"kv-reclaim-{uuid}").start()
+        return True
+
+    def _reap(self) -> None:
+        """TTL backstop for offers that were never signalled (router died
+        between the PD legs)."""
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            expired = [u for u, (dl, _) in self._pending.items() if dl < now]
+        for u in expired:
+            logger.warning("kv offer %d expired without signal; reclaiming", u)
+            self.reclaim(u)
+
+    def pull(self, address: str, uuid: int, shapes_dtypes: list):
+        """Pull arrays offered by a remote TransferManager into local
+        device memory.  ``shapes_dtypes``: [(shape, dtype), ...]."""
+        import jax
+
+        with self._lock:
+            conn = self._connections.get(address)
+            if conn is None:
+                conn = self.server.connect(address)
+                self._connections[address] = conn
+        sharding = jax.sharding.SingleDeviceSharding(self._device)
+        specs = [
+            jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+            for shape, dtype in shapes_dtypes
+        ]
+        return conn.pull(uuid, specs)

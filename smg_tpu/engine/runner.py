@@ -882,6 +882,25 @@ class ModelRunner:
         )
         return np.asarray(toks), np.asarray(lps)
 
+    @property
+    def kv_transfer(self):
+        """Lazy per-runner TransferManager (cross-host KV pulls)."""
+        if getattr(self, "_kv_transfer", None) is None:
+            from smg_tpu.engine.kv_transfer import TransferManager
+
+            device = next(iter(self.k_cache.devices()))
+            self._kv_transfer = TransferManager(device)
+        return self._kv_transfer
+
+    @property
+    def supports_kv_transfer(self) -> bool:
+        """True when this engine can serve/accept cross-host KV pulls —
+        single-device legs only (sharded multi-controller pulls are future
+        work; see engine/kv_transfer.py)."""
+        from smg_tpu.engine.kv_transfer import transfer_available
+
+        return transfer_available() and self.mesh is None
+
     def export_pages(self, pages: "list[int]") -> tuple[np.ndarray, np.ndarray]:
         """Fetch KV pages to host: ([L, n, ps, KD] k, v).
 

@@ -344,13 +344,23 @@ class Router:
         # prefill still get a fresh choice.
         connector = self.config.kv_connector
         if connector == "auto":
-            connector = (
-                "device"
-                if p_worker.client.supports_device_kv
-                and decode_pool
-                and all(w.client.supports_device_kv for w in decode_pool)
-                else "host"
-            )
+            if (p_worker.client.supports_device_kv and decode_pool
+                    and all(w.client.supports_device_kv for w in decode_pool)):
+                # colocated legs (one controller): direct device_put
+                connector = "device"
+            else:
+                # remote legs: device-to-device pull when both sides run a
+                # transfer server (reference: NIXL/Mooncake negotiation),
+                # else host bytes
+                connector = "host"
+                try:
+                    infos = [await self.worker_info(p_worker)] + [
+                        await self.worker_info(w) for w in decode_pool
+                    ]
+                    if infos and all(i.get("supports_kv_transfer") for i in infos):
+                        connector = "transfer"
+                except Exception:
+                    pass
 
         p_guard = p_worker.acquire()
         try:
@@ -381,11 +391,33 @@ class Router:
             export["connector"] = "host"
         d_guard = d_worker.acquire()
         finished_cleanly = False
+        # transfer mode: the prefill worker's offered KV stays pinned until
+        # the decode leg pulls it — signal the outcome so success stops the
+        # tracking and failure triggers reclamation (engine/kv_transfer.py)
+        offer_uuid = (
+            export["k"].get("transfer_uuid")
+            if export.get("connector") == "transfer" else None
+        )
+        signalled = False
+
+        async def _signal(consumed: bool):
+            nonlocal signalled
+            if offer_uuid is None or signalled:
+                return
+            signalled = True
+            try:
+                await asyncio.shield(
+                    p_worker.client.release_kv_offer(offer_uuid, consumed)
+                )
+            except Exception:
+                logger.warning("kv offer %s signal failed", offer_uuid)
+
         try:
             wreq = WorkerGenerateRequest(rid=rid, input_ids=input_ids, sampling=worker_sampling)
             async for chunk in d_worker.client.generate_prefilled(
                 wreq, export["first_token"], export["k"], export["v"]
             ):
+                await _signal(consumed=True)  # decode leg is live: KV pulled
                 ev = self._chunk_to_event(chunk, detok, stop_checker)
                 if ev is not None:
                     yield ev
@@ -413,6 +445,8 @@ class Router:
             d_guard.release(success=False)
             raise RouteError(502, f"decode worker error: {e}", "worker_error")
         finally:
+            # no chunk ever arrived: the offer was never pulled — reclaim
+            await _signal(consumed=False)
             if not finished_cleanly:
                 d_guard.release(success=True)
 

@@ -75,6 +75,10 @@ class WorkerClient:
         """PD prefill leg: {first_token, k, v, seq_len, connector}."""
         raise NotImplementedError
 
+    async def release_kv_offer(self, uuid: int, consumed: bool) -> bool:
+        """PD transfer lifecycle signal (no-op for non-transfer workers)."""
+        return False
+
     def generate_prefilled(self, req, first_token: int, k, v):
         """PD decode leg: async iterator of WorkerStreamChunk."""
         raise NotImplementedError
@@ -177,6 +181,10 @@ class InProcWorkerClient(WorkerClient):
             None, lambda: self.engine.encode_image(pixel_values, grid)
         )
 
+    async def release_kv_offer(self, uuid: int, consumed: bool) -> bool:
+        mgr = self.engine.runner.kv_transfer
+        return mgr.mark_consumed(uuid) if consumed else mgr.reclaim(uuid)
+
     async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
@@ -232,6 +240,7 @@ class InProcWorkerClient(WorkerClient):
             "eos_token_ids": list(cfg.model.eos_token_ids),
             "page_size": cfg.cache.page_size,
             "supports_vision": self.engine.supports_vision,
+            "supports_kv_transfer": self.engine.runner.supports_kv_transfer,
         }
         if self.engine.supports_vision:
             info.update(

@@ -5,6 +5,7 @@ tokens for prompt expansion."""
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -80,10 +81,151 @@ class LlavaImageProcessor(ImageProcessor):
         )
 
 
+class InternVLImageProcessor(ImageProcessor):
+    """InternVL dynamic tiling: the image is split into up to ``max_tiles``
+    aspect-ratio-matched 448x448 tiles plus a global thumbnail; each tile
+    contributes (448/patch/merge)^2 tokens (reference:
+    vision/processors/internvl)."""
+
+    name = "internvl"
+
+    def __init__(self, tile_size: int = 448, patch_size: int = 14,
+                 merge_size: int = 2, max_tiles: int = 12,
+                 use_thumbnail: bool = True):
+        self.tile_size = tile_size
+        self.patch_size = patch_size
+        self.merge_size = merge_size
+        self.max_tiles = max_tiles
+        self.use_thumbnail = use_thumbnail
+
+    def _grid_for(self, h: int, w: int) -> tuple[int, int]:
+        """Best (rows, cols) tiling with rows*cols <= max_tiles, closest to
+        the image's aspect ratio."""
+        best, best_diff = (1, 1), float("inf")
+        ratio = w / h
+        for rows in range(1, self.max_tiles + 1):
+            for cols in range(1, self.max_tiles // rows + 1):
+                diff = abs(cols / rows - ratio)
+                if diff < best_diff or (
+                    diff == best_diff and rows * cols > best[0] * best[1]
+                ):
+                    best, best_diff = (rows, cols), diff
+        return best
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        H, W = img.shape[:2]
+        rows, cols = self._grid_for(H, W)
+        ts = self.tile_size
+        resized = normalize_image(resize_image(img, rows * ts, cols * ts))
+        tiles = [
+            resized[r * ts:(r + 1) * ts, c * ts:(c + 1) * ts]
+            for r in range(rows) for c in range(cols)
+        ]
+        if self.use_thumbnail and len(tiles) > 1:
+            tiles.append(normalize_image(resize_image(img, ts, ts)))
+        pixel = jnp.concatenate(
+            [patchify(t, self.patch_size)[0] for t in tiles], axis=0
+        )
+        g = ts // self.patch_size
+        per_tile = (g // self.merge_size) ** 2
+        # grid covers the stacked tiles vertically: (n_tiles * g, g)
+        return ProcessedImage(
+            pixel_values=pixel, grid=(len(tiles) * g, g),
+            num_placeholder_tokens=len(tiles) * per_tile,
+        )
+
+
+class PixtralImageProcessor(ImageProcessor):
+    """Pixtral: longest side capped (default 1024), aspect preserved, snap
+    to patch multiples; one token per patch (no spatial merge)."""
+
+    name = "pixtral"
+
+    def __init__(self, max_size: int = 1024, patch_size: int = 16):
+        self.max_size = max_size
+        self.patch_size = patch_size
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        H, W = img.shape[:2]
+        scale = min(1.0, self.max_size / max(H, W))
+        ps = self.patch_size
+        h2 = max(ps, int(round(H * scale / ps)) * ps)
+        w2 = max(ps, int(round(W * scale / ps)) * ps)
+        img = normalize_image(resize_image(img, h2, w2))
+        patches, grid = patchify(img, ps)
+        return ProcessedImage(
+            pixel_values=patches, grid=grid,
+            num_placeholder_tokens=grid[0] * grid[1],
+        )
+
+
+class Gemma3ImageProcessor(ImageProcessor):
+    """Gemma 3: fixed square resize (896), patch 14, 4x4 pooled merge ->
+    256 tokens per image."""
+
+    name = "gemma3"
+
+    def __init__(self, image_size: int = 896, patch_size: int = 14,
+                 merge_size: int = 4):
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.merge_size = merge_size
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        img = normalize_image(resize_image(img, self.image_size, self.image_size))
+        patches, grid = patchify(img, self.patch_size)
+        merged = (grid[0] // self.merge_size) * (grid[1] // self.merge_size)
+        return ProcessedImage(
+            pixel_values=patches, grid=grid, num_placeholder_tokens=merged
+        )
+
+
+class Phi3VisionImageProcessor(ImageProcessor):
+    """Phi-3.5-vision HD transform: pad/resize to 336-multiples under a
+    crop budget, plus a 336x336 global view."""
+
+    name = "phi3_v"
+
+    def __init__(self, base: int = 336, patch_size: int = 14,
+                 max_crops: int = 4, merge_size: int = 2):
+        self.base = base
+        self.patch_size = patch_size
+        self.max_crops = max_crops
+        self.merge_size = merge_size
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        H, W = img.shape[:2]
+        ratio = W / H
+        cols = max(1, min(self.max_crops, int(round(math.sqrt(self.max_crops * ratio)))))
+        rows = max(1, self.max_crops // cols)
+        b = self.base
+        main = normalize_image(resize_image(img, rows * b, cols * b))
+        # uniform base-size views stacked vertically (global + crops) so the
+        # grid is consistent with the patch rows the tower receives
+        views = [normalize_image(resize_image(img, b, b))] + [
+            main[r * b:(r + 1) * b, c * b:(c + 1) * b]
+            for r in range(rows) for c in range(cols)
+        ]
+        pixel = jnp.concatenate(
+            [patchify(v, self.patch_size)[0] for v in views], axis=0
+        )
+        g = b // self.patch_size
+        m2 = self.merge_size ** 2
+        tokens = len(views) * (g * g) // m2
+        return ProcessedImage(
+            pixel_values=pixel, grid=(len(views) * g, g),
+            num_placeholder_tokens=tokens,
+        )
+
+
 _PROCESSORS = {
     "qwen2_vl": Qwen2VLImageProcessor,
     "qwen3_vl": Qwen2VLImageProcessor,
     "llava": LlavaImageProcessor,
+    "internvl": InternVLImageProcessor,
+    "pixtral": PixtralImageProcessor,
+    "gemma3": Gemma3ImageProcessor,
+    "phi3_v": Phi3VisionImageProcessor,
 }
 
 _MODEL_MAP = [
@@ -91,6 +233,13 @@ _MODEL_MAP = [
     ("qwen2.5-vl", "qwen2_vl"),
     ("qwen3-vl", "qwen3_vl"),
     ("llava", "llava"),
+    ("internvl", "internvl"),
+    ("pixtral", "pixtral"),
+    ("mistral-small", "pixtral"),
+    ("gemma-3", "gemma3"),
+    ("gemma3", "gemma3"),
+    ("phi-3", "phi3_v"),
+    ("phi-3.5", "phi3_v"),
 ]
 
 
@@ -120,8 +269,15 @@ def processor_for_worker(
         if sub in key:
             family = name
             break
+    ps, ms = patch_size, merge_size
     if family == "llava":
-        return LlavaImageProcessor(patch_size=patch_size or 14)
-    return Qwen2VLImageProcessor(
-        patch_size=patch_size or 14, merge_size=merge_size or 2
-    )
+        return LlavaImageProcessor(patch_size=ps or 14)
+    if family == "internvl":
+        return InternVLImageProcessor(patch_size=ps or 14, merge_size=ms or 2)
+    if family == "pixtral":
+        return PixtralImageProcessor(patch_size=ps or 16)
+    if family == "gemma3":
+        return Gemma3ImageProcessor(patch_size=ps or 14, merge_size=ms or 4)
+    if family == "phi3_v":
+        return Phi3VisionImageProcessor(patch_size=ps or 14, merge_size=ms or 2)
+    return Qwen2VLImageProcessor(patch_size=ps or 14, merge_size=ms or 2)

@@ -1,0 +1,77 @@
+"""Video preprocessing: frame sampling + per-frame vision processing.
+
+Reference: ``crates/multimodal`` video capture (OpenCV buffer capture,
+``opencv_buffer_capture.cpp``).  Codec demuxing is out of scope for this
+environment (no ffmpeg/OpenCV); multi-frame containers PIL understands
+(GIF/APNG/multipage TIFF) decode in-tree and pre-extracted frame lists are
+accepted directly — the sampling + per-frame pipeline is the part the
+serving path owns either way.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def decode_video_bytes(raw: bytes, max_frames: int = 256) -> list[np.ndarray]:
+    """Multi-frame image container -> list of RGB uint8 [H, W, 3] frames."""
+    from PIL import Image, ImageSequence
+
+    img = Image.open(io.BytesIO(raw))
+    frames = []
+    for frame in ImageSequence.Iterator(img):
+        frames.append(np.asarray(frame.convert("RGB"), np.uint8))
+        if len(frames) >= max_frames:
+            break
+    if not frames:
+        raise ValueError("no frames decoded")
+    return frames
+
+
+def sample_frames(frames: list, num_frames: int) -> list:
+    """Uniform temporal sampling (the standard VLM recipe)."""
+    if len(frames) <= num_frames:
+        return list(frames)
+    idx = np.linspace(0, len(frames) - 1, num_frames).round().astype(int)
+    return [frames[i] for i in idx]
+
+
+@dataclass
+class ProcessedVideo:
+    pixel_values: "object"        # [sum_patches, patch_dim]
+    frame_grids: list             # per-frame (gh, gw)
+    num_placeholder_tokens: int
+    num_frames: int
+
+
+class VideoProcessor:
+    """Per-frame image processing with uniform sampling; token count is the
+    per-frame sum (temporal pooling is a model-side concern — Qwen2-VL's
+    temporal_patch_size rides the tower, not the host pipeline)."""
+
+    def __init__(self, image_processor, num_frames: int = 8):
+        self.image_processor = image_processor
+        self.num_frames = num_frames
+
+    def process(self, frames: list) -> ProcessedVideo:
+        import jax.numpy as jnp
+
+        picked = sample_frames(frames, self.num_frames)
+        parts, grids, tokens = [], [], 0
+        for f in picked:
+            p = self.image_processor.process(f)
+            parts.append(p.pixel_values)
+            grids.append(p.grid)
+            tokens += p.num_placeholder_tokens
+        return ProcessedVideo(
+            pixel_values=jnp.concatenate(parts, axis=0),
+            frame_grids=grids,
+            num_placeholder_tokens=tokens,
+            num_frames=len(picked),
+        )
+
+    def process_bytes(self, raw: bytes) -> ProcessedVideo:
+        return self.process(decode_video_bytes(raw))

@@ -103,6 +103,11 @@ class GrpcWorkerClient(WorkerClient):
             request_serializer=pb.EmptyProto.SerializeToString,
             response_deserializer=pb.ProfileResponseProto.FromString,
         )
+        self._release_kv_offer = c.unary_unary(
+            method("ReleaseKvOffer"),
+            request_serializer=pb.KvOfferProto.SerializeToString,
+            response_deserializer=pb.AbortResponseProto.FromString,
+        )
         self._abort = c.unary_unary(
             method("Abort"),
             request_serializer=pb.AbortRequestProto.SerializeToString,
@@ -166,8 +171,9 @@ class GrpcWorkerClient(WorkerClient):
             call.cancel()
 
     async def prefill_export(self, input_ids: list, sampling, connector: str = "host") -> dict:
-        # gRPC is inherently host-mediated: the payload crosses the wire as
-        # bytes regardless of the requested connector
+        # gRPC legs: either host bytes on the wire, or "transfer" — the
+        # response carries only a pull descriptor and the decode worker
+        # fetches the KV device-to-device (jax.experimental.transfer)
         import numpy as np
 
         if connector == "device":
@@ -175,15 +181,30 @@ class GrpcWorkerClient(WorkerClient):
                 "kv connector 'device' requested but %s is a gRPC transport; "
                 "staging KV via host bytes", self.url,
             )
+            connector = "host"
         resp = await self._prefill_export(
             pb.PrefillExportRequestProto(
-                rid="prefill", input_ids=input_ids, sampling=sampling_to_proto(sampling)
+                rid="prefill", input_ids=input_ids,
+                sampling=sampling_to_proto(sampling), connector=connector,
             ),
             timeout=600,
         )
         if resp.error:
             raise RuntimeError(f"prefill export error: {resp.error}")
         shape = tuple(resp.kv_shape)
+        if resp.transfer_address:
+            desc = {
+                "transfer_address": resp.transfer_address,
+                "transfer_uuid": resp.transfer_uuid,
+                "kv_shape": shape,
+                "kv_dtype": resp.kv_dtype,
+            }
+            return {
+                "first_token": resp.first_token,
+                "seq_len": resp.seq_len,
+                "k": desc, "v": desc,
+                "connector": "transfer",
+            }
         return {
             "first_token": resp.first_token,
             "seq_len": resp.seq_len,
@@ -199,9 +220,17 @@ class GrpcWorkerClient(WorkerClient):
                 sampling=sampling_to_proto(req.sampling),
             ),
             first_token=first_token,
-            k=k.tobytes(), v=v.tobytes(),
-            kv_shape=list(k.shape), kv_dtype=str(k.dtype),
         )
+        if isinstance(k, dict) and "transfer_address" in k:
+            msg.transfer_address = k["transfer_address"]
+            msg.transfer_uuid = int(k["transfer_uuid"])
+            msg.kv_shape.extend(list(k["kv_shape"]))
+            msg.kv_dtype = k["kv_dtype"]
+        else:
+            msg.k = k.tobytes()
+            msg.v = v.tobytes()
+            msg.kv_shape.extend(list(k.shape))
+            msg.kv_dtype = str(k.dtype)
         call = self._generate_prefilled(msg)
         try:
             async for chunk in call:
@@ -252,6 +281,15 @@ class GrpcWorkerClient(WorkerClient):
             resp.rows, resp.cols
         )
 
+    async def release_kv_offer(self, uuid: int, consumed: bool) -> bool:
+        try:
+            resp = await self._release_kv_offer(
+                pb.KvOfferProto(uuid=int(uuid), consumed=consumed), timeout=10
+            )
+            return resp.ok
+        except grpc.aio.AioRpcError:
+            return False
+
     async def abort(self, rid: str) -> bool:
         try:
             resp = await self._abort(pb.AbortRequestProto(rid=rid), timeout=5)
@@ -287,6 +325,7 @@ class GrpcWorkerClient(WorkerClient):
             "page_size": resp.page_size,
             "dp_size": resp.dp_size or 1,
             "supports_vision": resp.supports_vision,
+            "supports_kv_transfer": resp.supports_kv_transfer,
         }
         if resp.supports_vision:
             info.update(

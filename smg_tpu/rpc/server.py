@@ -156,14 +156,28 @@ class SchedulerServicer:
             return pb.EncodeResponseProto(error=str(e))
 
     async def PrefillExport(self, request: pb.PrefillExportRequestProto, context):
-        import numpy as np
-
         loop = asyncio.get_running_loop()
         try:
             sampling = sampling_from_proto(request.sampling)
+            connector = request.connector or "host"
+            if connector not in ("host", "transfer"):
+                connector = "host"  # gRPC legs: bytes or pull, never local device
             result = await loop.run_in_executor(
-                None, self.engine.prefill_export, list(request.input_ids), sampling
+                None,
+                lambda: self.engine.prefill_export(
+                    list(request.input_ids), sampling, connector=connector
+                ),
             )
+            if result.get("connector") == "transfer":
+                desc = result["k"]
+                return pb.PrefillExportResponseProto(
+                    first_token=result["first_token"],
+                    seq_len=result["seq_len"],
+                    kv_shape=list(desc["kv_shape"]),
+                    kv_dtype=desc["kv_dtype"],
+                    transfer_address=desc["transfer_address"],
+                    transfer_uuid=desc["transfer_uuid"],
+                )
             k, v = result["k"], result["v"]
             return pb.PrefillExportResponseProto(
                 first_token=result["first_token"],
@@ -183,8 +197,18 @@ class SchedulerServicer:
         base = request.base
         sampling = sampling_from_proto(base.sampling)
         shape = tuple(request.kv_shape)
-        k = np.frombuffer(request.k, dtype=request.kv_dtype).reshape(shape)
-        v = np.frombuffer(request.v, dtype=request.kv_dtype).reshape(shape)
+        if request.transfer_address:
+            # transfer mode: the payload is a pull descriptor — the
+            # engine-side connector fetches device-to-device
+            k = v = {
+                "transfer_address": request.transfer_address,
+                "transfer_uuid": request.transfer_uuid,
+                "kv_shape": shape,
+                "kv_dtype": request.kv_dtype,
+            }
+        else:
+            k = np.frombuffer(request.k, dtype=request.kv_dtype).reshape(shape)
+            v = np.frombuffer(request.v, dtype=request.kv_dtype).reshape(shape)
 
         def on_output(out) -> None:  # engine thread
             loop.call_soon_threadsafe(q.put_nowait, out)
@@ -217,6 +241,16 @@ class SchedulerServicer:
                     return
         finally:
             self.engine.abort(rid)
+
+    async def ReleaseKvOffer(self, request: pb.KvOfferProto, context):
+        """PD transfer lifecycle: consumed offers stop being tracked;
+        abandoned ones are self-reclaimed (engine/kv_transfer.py)."""
+        mgr = self.engine.runner.kv_transfer
+        if request.consumed:
+            ok = mgr.mark_consumed(request.uuid)
+        else:
+            ok = mgr.reclaim(request.uuid)
+        return pb.AbortResponseProto(ok=ok)
 
     async def Abort(self, request: pb.AbortRequestProto, context):
         ok = any(e.abort(request.rid) for e in self.engines)
@@ -251,6 +285,7 @@ class SchedulerServicer:
             msg.image_token_id = cfg.model.image_token_id or 0
             msg.vision_patch_size = cfg.model.vision.patch_size
             msg.vision_merge_size = cfg.model.vision.merge_size
+        msg.supports_kv_transfer = self.engine.runner.supports_kv_transfer
         return msg
 
     async def FlushCache(self, request: pb.EmptyProto, context):
@@ -379,6 +414,11 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             servicer.EmbedBatch,
             request_deserializer=pb.EmbedBatchRequestProto.FromString,
             response_serializer=pb.EmbedBatchResponseProto.SerializeToString,
+        ),
+        "ReleaseKvOffer": grpc.unary_unary_rpc_method_handler(
+            servicer.ReleaseKvOffer,
+            request_deserializer=pb.KvOfferProto.FromString,
+            response_serializer=pb.AbortResponseProto.SerializeToString,
         ),
         "Abort": grpc.unary_unary_rpc_method_handler(
             servicer.Abort,

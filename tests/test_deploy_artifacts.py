@@ -1,0 +1,61 @@
+"""Deploy-tier sanity (reference: deploy/helm/smg + docker/Dockerfile):
+the chart's values cover every value referenced by the templates, and all
+static YAML parses."""
+
+import os
+import re
+
+import yaml
+
+HERE = os.path.join(os.path.dirname(__file__), "..", "deploy")
+
+
+def test_chart_and_values_parse():
+    chart = yaml.safe_load(open(os.path.join(HERE, "helm/smg-tpu/Chart.yaml")))
+    assert chart["name"] == "smg-tpu"
+    values = yaml.safe_load(open(os.path.join(HERE, "helm/smg-tpu/values.yaml")))
+    assert values["worker"]["tpu"]["resource"] == "google.com/tpu"
+    assert values["gateway"]["port"] == 30000
+
+
+def test_templates_reference_defined_values():
+    """Every `.Values.foo.bar` path in the templates resolves in values.yaml
+    (catches typos without needing helm in the image)."""
+    values = yaml.safe_load(open(os.path.join(HERE, "helm/smg-tpu/values.yaml")))
+    tdir = os.path.join(HERE, "helm/smg-tpu/templates")
+    pattern = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+    missing = []
+    for fname in os.listdir(tdir):
+        src = open(os.path.join(tdir, fname)).read()
+        for path in pattern.findall(src):
+            node = values
+            for part in path.split("."):
+                if not isinstance(node, dict) or part not in node:
+                    missing.append(f"{fname}: .Values.{path}")
+                    break
+                node = node[part]
+    assert not missing, missing
+
+
+def test_worker_args_match_cli_flags():
+    """Flags the chart passes must exist in the CLI parser."""
+    from smg_tpu.cli import build_parser
+
+    parser = build_parser()
+    known = set()
+    for action in parser._subparsers._group_actions[0].choices.values():
+        for a in action._actions:
+            known.update(a.option_strings)
+    tdir = os.path.join(HERE, "helm/smg-tpu/templates")
+    flag_re = re.compile(r'"(--[a-z-]+)=')
+    for fname in ("deployment-gateway.yaml", "statefulset-worker.yaml"):
+        src = open(os.path.join(tdir, fname)).read()
+        for flag in flag_re.findall(src):
+            assert flag in known, f"{fname} passes unknown CLI flag {flag}"
+
+
+def test_compose_parses():
+    compose = yaml.safe_load(open(os.path.join(HERE, "docker/docker-compose.yaml")))
+    assert set(compose["services"]) == {"gateway", "worker-0", "worker-1", "redis"}
+    gw_cmd = compose["services"]["gateway"]["command"]
+    assert any(c.startswith("--worker=") for c in gw_cmd)

@@ -1,0 +1,219 @@
+"""Multimodal breadth (VERDICT r3 missing #7): vision processor families,
+audio log-mel front-end (torch.stft as the independent oracle), video frame
+sampling."""
+
+import io
+
+import numpy as np
+import pytest
+
+from smg_tpu.multimodal.processor import (
+    Gemma3ImageProcessor,
+    InternVLImageProcessor,
+    LlavaImageProcessor,
+    Phi3VisionImageProcessor,
+    PixtralImageProcessor,
+    Qwen2VLImageProcessor,
+    get_image_processor,
+)
+
+
+def _img(h, w, seed=0):
+    return np.random.default_rng(seed).integers(0, 255, (h, w, 3), np.uint8)
+
+
+def test_processor_registry_families():
+    assert isinstance(get_image_processor("OpenGVLab/InternVL2-8B"),
+                      InternVLImageProcessor)
+    assert isinstance(get_image_processor("mistralai/Pixtral-12B"),
+                      PixtralImageProcessor)
+    assert isinstance(get_image_processor("google/gemma-3-12b-it"),
+                      Gemma3ImageProcessor)
+    assert isinstance(get_image_processor("microsoft/Phi-3.5-vision"),
+                      Phi3VisionImageProcessor)
+    assert isinstance(get_image_processor("Qwen/Qwen2-VL-7B"),
+                      Qwen2VLImageProcessor)
+    assert isinstance(get_image_processor("llava-hf/llava-1.5"),
+                      LlavaImageProcessor)
+
+
+def test_internvl_tiling_counts():
+    p = InternVLImageProcessor(tile_size=448, patch_size=14, merge_size=2,
+                               max_tiles=6)
+    out = p.process(_img(300, 600))  # 2:1 -> 1 row x 2 cols (+thumbnail)
+    g = 448 // 14  # 32
+    per_tile = (g // 2) ** 2  # 256
+    assert out.num_placeholder_tokens == 3 * per_tile  # 2 tiles + thumbnail
+    assert out.pixel_values.shape == (3 * g * g, 14 * 14 * 3)
+    # square image, small tiles budget: 1 tile, no thumbnail
+    out2 = InternVLImageProcessor(max_tiles=1).process(_img(100, 100))
+    assert out2.num_placeholder_tokens == 256
+
+
+def test_pixtral_aspect_preserved():
+    p = PixtralImageProcessor(max_size=256, patch_size=16)
+    out = p.process(_img(512, 256))  # 2:1 portrait -> 256 x 128
+    assert out.grid == (16, 8)
+    assert out.num_placeholder_tokens == 128
+    # no merge: one token per patch
+    assert out.pixel_values.shape[0] == 128
+
+
+def test_gemma3_fixed_budget():
+    out = Gemma3ImageProcessor().process(_img(123, 777))
+    assert out.num_placeholder_tokens == 256  # (896/14/4)^2
+
+
+def test_phi3_hd_views():
+    p = Phi3VisionImageProcessor(base=336, patch_size=14, max_crops=4)
+    out = p.process(_img(336, 672))  # 2:1 -> cols=3, rows=1 -> 3 crops + global
+    g = 336 // 14  # 24
+    n_views = out.grid[0] // g
+    # grid consistent with the stacked patch rows (the vit tower contract)
+    assert out.pixel_values.shape[0] == out.grid[0] * out.grid[1]
+    assert out.grid[1] == g
+    assert out.num_placeholder_tokens == n_views * (g * g) // 4
+    assert out.pixel_values.shape[1] == 14 * 14 * 3
+    # square image: 2x2 crops + global = 5 uniform views
+    out2 = p.process(_img(200, 200))
+    assert out2.grid == (5 * g, g)
+    assert out2.pixel_values.shape[0] == 5 * g * g
+
+
+# ---- audio ----
+
+
+def _tone(freq=440.0, secs=1.0, rate=16000):
+    t = np.arange(int(secs * rate)) / rate
+    return (0.5 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+def _wav_bytes(x, rate=16000, width=2):
+    import wave
+
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(width)
+        w.setframerate(rate)
+        if width == 2:
+            w.writeframes((x * 32767).astype("<i2").tobytes())
+        else:
+            w.writeframes(((x * 127) + 128).astype(np.uint8).tobytes())
+    return buf.getvalue()
+
+
+def test_wav_decode_roundtrip():
+    from smg_tpu.multimodal.audio import decode_wav
+
+    x = _tone()
+    y, rate = decode_wav(_wav_bytes(x))
+    assert rate == 16000
+    np.testing.assert_allclose(y, x, atol=1e-3)
+
+
+def test_resample_preserves_tone():
+    from smg_tpu.multimodal.audio import resample
+
+    x = _tone(rate=8000)
+    y = resample(x, 8000, 16000)
+    assert abs(len(y) - 2 * len(x)) <= 1
+    # dominant frequency preserved
+    spec = np.abs(np.fft.rfft(y))
+    peak_hz = np.argmax(spec) * 16000 / len(y)
+    assert abs(peak_hz - 440.0) < 5
+
+
+def test_log_mel_against_torch_stft():
+    """The power spectrogram under our framing matches torch.stft (the
+    independent DSP oracle); the mel projection then peaks at the tone."""
+    import torch
+
+    from smg_tpu.multimodal.audio import log_mel_spectrogram, mel_filterbank
+
+    x = _tone(freq=1000.0)
+    n_fft, hop = 400, 160
+    window = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+    ours_frames = None
+    # reproduce the framing: reflect pad + strided frames
+    pad = n_fft // 2
+    xp = np.pad(x, pad, mode="reflect")
+    n_frames = 1 + (len(xp) - n_fft) // hop
+    frames = np.lib.stride_tricks.as_strided(
+        xp, shape=(n_frames, n_fft), strides=(xp.strides[0] * hop, xp.strides[0])
+    )
+    ours_power = np.abs(np.fft.rfft(frames * window, axis=1).T) ** 2
+
+    t_spec = torch.stft(
+        torch.from_numpy(x), n_fft, hop_length=hop,
+        window=torch.from_numpy(window), center=True, pad_mode="reflect",
+        return_complex=True,
+    )
+    t_power = (t_spec.abs() ** 2).numpy()[:, :ours_power.shape[1]]
+    np.testing.assert_allclose(ours_power, t_power, rtol=1e-3, atol=1e-4)
+
+    feats = log_mel_spectrogram(x)
+    assert feats.shape[0] == 80
+    # the mel bin containing 1 kHz carries the peak energy
+    fb = mel_filterbank(80, n_fft, 16000)
+    onek_bin = np.argmax(fb[:, int(1000 * n_fft / 16000)])
+    mean_per_mel = feats.mean(axis=1)
+    assert abs(int(np.argmax(mean_per_mel)) - int(onek_bin)) <= 1
+
+
+def test_whisper_processor_shapes():
+    from smg_tpu.multimodal.audio import WhisperAudioProcessor
+
+    feats, tokens = WhisperAudioProcessor().process(_tone(secs=2.0))
+    assert feats.shape == (80, 3000)  # 30 s padded, 10 ms hop
+    assert tokens == 1500
+
+
+def test_qwen2_audio_variable_length():
+    from smg_tpu.multimodal.audio import Qwen2AudioProcessor
+
+    feats, tokens = Qwen2AudioProcessor().process(_tone(secs=2.0))
+    assert feats.shape[0] == 128
+    assert 190 <= feats.shape[1] <= 210  # ~2 s of 10 ms hops
+    assert tokens == feats.shape[1] // 2
+
+
+def test_audio_bytes_path():
+    from smg_tpu.multimodal.audio import WhisperAudioProcessor
+
+    feats, tokens = WhisperAudioProcessor().process_bytes(_wav_bytes(_tone()))
+    assert feats.shape == (80, 3000) and tokens == 1500
+
+
+# ---- video ----
+
+
+def test_video_sampling_and_tokens():
+    from smg_tpu.multimodal.video import VideoProcessor, sample_frames
+
+    frames = [_img(64, 64, seed=i) for i in range(20)]
+    assert len(sample_frames(frames, 8)) == 8
+    assert sample_frames(frames, 8)[0] is frames[0]
+    assert sample_frames(frames, 8)[-1] is frames[-1]
+
+    vp = VideoProcessor(Qwen2VLImageProcessor(patch_size=4, merge_size=2),
+                        num_frames=4)
+    out = vp.process(frames)
+    assert out.num_frames == 4
+    assert len(out.frame_grids) == 4
+    per_frame = out.num_placeholder_tokens // 4
+    assert per_frame >= 1
+
+
+def test_video_gif_decode():
+    from PIL import Image
+
+    from smg_tpu.multimodal.video import decode_video_bytes
+
+    frames = [Image.fromarray(_img(16, 16, seed=i)) for i in range(5)]
+    buf = io.BytesIO()
+    frames[0].save(buf, format="GIF", save_all=True,
+                   append_images=frames[1:], duration=50)
+    decoded = decode_video_bytes(buf.getvalue())
+    assert len(decoded) == 5
+    assert decoded[0].shape == (16, 16, 3)

@@ -227,3 +227,138 @@ def test_gateway_routes_configured_connector(pd_gateway):
     finally:
         pd_gateway.p_engine.prefill_export = orig
     assert calls == [pd_gateway.kv_connector], calls
+
+
+def test_engine_level_transfer_connector():
+    """Cross-host transfer connector (jax.experimental.transfer): the
+    prefill leg offers device KV under a uuid, the decode leg pulls it
+    device-to-device; only the descriptor crosses the control path.
+    Token-exact vs local generation (VERDICT r3 next-round #4)."""
+    from smg_tpu.engine.kv_transfer import transfer_available
+
+    if not transfer_available():
+        pytest.skip("jax.experimental.transfer unavailable")
+    a = make_engine()
+    b = make_engine()
+    try:
+        prompt = list(range(7, 47))
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8, ignore_eos=True)
+        ref = a.generate(prompt_ids=prompt, sampling=sp)
+        a.flush_cache()
+
+        export = a.prefill_export(prompt, sp, connector="transfer")
+        assert export["connector"] == "transfer"
+        desc = export["k"]
+        assert "transfer_address" in desc and desc["transfer_uuid"]
+        assert tuple(desc["kv_shape"])[0] == 4  # L layers
+
+        outs, done = [], threading.Event()
+
+        def cb(o):
+            outs.append(o)
+            if o.finished:
+                done.set()
+
+        b.submit_prefilled(prompt, export["first_token"], export["k"],
+                           export["v"], sp, on_output=cb)
+        for _ in range(300):
+            b.step()
+            if done.is_set():
+                break
+        tokens = [t for o in outs for t in o.new_token_ids]
+        assert tokens == ref.token_ids
+        assert b.scheduler.num_prefill_tokens == 0
+    finally:
+        a.stop(); b.stop()
+
+
+def test_transfer_pd_over_real_grpc():
+    """Full PD pair over gRPC with the transfer connector: gRPC carries
+    only the pull descriptor; tokens match a plain generation."""
+    from smg_tpu.engine.kv_transfer import transfer_available
+
+    if not transfer_available():
+        pytest.skip("jax.experimental.transfer unavailable")
+    from smg_tpu.gateway.worker_client import WorkerGenerateRequest
+    from smg_tpu.rpc.client import GrpcWorkerClient
+    from smg_tpu.rpc.server import serve_worker_async
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=300):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    p_eng, d_eng = make_engine("pd-p"), make_engine("pd-d")
+    p_eng.start(); d_eng.start()
+    try:
+        async def _setup():
+            ps = await serve_worker_async(p_eng, port=0, host="127.0.0.1")
+            ds = await serve_worker_async(d_eng, port=0, host="127.0.0.1")
+            return (ps, GrpcWorkerClient(f"127.0.0.1:{ps._bound_port}"),
+                    ds, GrpcWorkerClient(f"127.0.0.1:{ds._bound_port}"))
+
+        ps, pc, ds, dc = run(_setup())
+        prompt = list(range(9, 49))
+        sp = SamplingParams(temperature=0.0, max_new_tokens=6, ignore_eos=True)
+        ref = p_eng.generate(prompt_ids=prompt, sampling=sp)
+        p_eng.flush_cache()
+
+        async def go():
+            info = await pc.get_model_info()
+            assert info["supports_kv_transfer"] is True
+            export = await pc.prefill_export(prompt, sp, connector="transfer")
+            assert export["connector"] == "transfer"
+            req = WorkerGenerateRequest(rid="pd-x", input_ids=prompt, sampling=sp)
+            toks = []
+            async for chunk in dc.generate_prefilled(
+                req, export["first_token"], export["k"], export["v"]
+            ):
+                toks.extend(chunk.token_ids)
+            return toks
+
+        tokens = run(go())
+        assert tokens == ref.token_ids
+        assert d_eng.scheduler.num_prefill_tokens == 0
+
+        async def _teardown():
+            await pc.close(); await dc.close()
+            await ps.stop(grace=None); await ds.stop(grace=None)
+
+        run(_teardown())
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        p_eng.stop(); d_eng.stop()
+
+
+def test_transfer_offer_lifecycle():
+    """Offers are tracked; consumed offers stop tracking, abandoned offers
+    are reclaimed by self-pull (releasing the pinned arrays)."""
+    import time
+
+    from smg_tpu.engine.kv_transfer import TransferManager, transfer_available
+
+    if not transfer_available():
+        pytest.skip("jax.experimental.transfer unavailable")
+    import jax
+    import jax.numpy as jnp
+
+    mgr = TransferManager(jax.devices("cpu")[0])
+    u1 = mgr.offer([jnp.zeros((2, 2))])
+    u2 = mgr.offer([jnp.ones((3,))])
+    assert set(mgr._pending) == {u1, u2}
+    # success path
+    assert mgr.mark_consumed(u1)
+    assert not mgr.mark_consumed(u1)
+    assert set(mgr._pending) == {u2}
+    # failure path: reclaim self-pulls in a daemon thread
+    assert mgr.reclaim(u2)
+    assert not mgr._pending
+    for _ in range(100):  # wait for the drain thread to consume the offer
+        if not any(t.name.startswith("kv-reclaim") and t.is_alive()
+                   for t in __import__("threading").enumerate()):
+            break
+        time.sleep(0.05)
+    assert not any(t.name.startswith("kv-reclaim") and t.is_alive()
+                   for t in __import__("threading").enumerate())
