@@ -55,6 +55,10 @@ def build_spec() -> dict:
         RerankRequest, RerankResponse, ClassifyRequest, ClassifyResponse,
         ModelList, ErrorResponse,
     ]
+    from smg_tpu.protocols.interactions import Interaction, InteractionsRequest
+    from smg_tpu.protocols.transcription import TranscriptionResponse
+
+    models += [InteractionsRequest, Interaction, TranscriptionResponse]
     _, defs = models_json_schema(
         [(m, "validation") for m in models],
         ref_template="#/components/schemas/{model}",
@@ -122,6 +126,13 @@ def build_spec() -> dict:
             "post": op("ops", "Register a gRPC worker"),
         },
         "/v1/conversations": {"post": op("openai", "Create conversation")},
+        "/v1/interactions": {"post": op(
+            "native", "Interactions API (stateful, chained turns)",
+            "InteractionsRequest", "Interaction", streaming=True)},
+        "/v1/audio/transcriptions": {"post": op(
+            "openai",
+            "Audio transcription (multipart/form-data: file + fields)",
+            None, "TranscriptionResponse")},
     }
 
     return {

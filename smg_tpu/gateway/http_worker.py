@@ -133,6 +133,39 @@ class HttpWorkerClient(WorkerClient):
                 raise HttpWorkerError(resp.status, await resp.text())
             return await resp.json()
 
+    async def post_multipart(
+        self, path: str, fields: dict[str, str], file_bytes: bytes,
+        filename: str = "audio.wav", file_field: str = "file",
+        content_type: str = "application/octet-stream",
+    ) -> dict[str, Any] | str:
+        """multipart/form-data forward (the transcription wire format —
+        reference: /v1/audio/transcriptions carries the audio out-of-band).
+        Returns parsed JSON, or raw text for text-ish response formats."""
+        import aiohttp
+
+        form = aiohttp.FormData()
+        for k, v in fields.items():
+            # list values become repeated form parts (e.g. the OpenAI
+            # timestamp_granularities[] convention)
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                form.add_field(k, str(item))
+        form.add_field(file_field, file_bytes, filename=filename,
+                       content_type=content_type)
+        # NOT self._headers(): its content-type json would clobber the
+        # multipart boundary aiohttp sets from the FormData
+        headers = {}
+        if self.api_key:
+            headers["authorization"] = f"Bearer {self.api_key}"
+        s = await self._sess()
+        async with s.post(
+            f"{self.url}{path}", data=form, headers=headers
+        ) as resp:
+            if resp.status != 200:
+                raise HttpWorkerError(resp.status, await resp.text())
+            if "json" in (resp.headers.get("Content-Type") or ""):
+                return await resp.json()
+            return await resp.text()
+
     async def stream_sse(
         self, path: str, body: dict[str, Any]
     ) -> AsyncIterator[dict[str, Any]]:

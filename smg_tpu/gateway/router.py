@@ -372,28 +372,10 @@ class Router:
             p_guard.release(success=False)
             raise RouteError(502, f"prefill worker error: {e}", "worker_error")
 
-        d_worker = policy.select_worker(decode_pool, ctx)
-        if d_worker is None:
-            raise RouteError(503, "no healthy decode workers", "service_unavailable")
-        if (
-            export.get("connector") == "device"
-            and not d_worker.client.supports_device_kv
-        ):
-            # a host-only decode worker joined mid-flight: degrade the payload
-            # (device->host pull runs off the event loop — it can be tens of
-            # MB through a device transfer)
-            import numpy as np
-
-            loop = asyncio.get_running_loop()
-            export["k"], export["v"] = await loop.run_in_executor(
-                None, lambda: (np.asarray(export["k"]), np.asarray(export["v"]))
-            )
-            export["connector"] = "host"
-        d_guard = d_worker.acquire()
-        finished_cleanly = False
         # transfer mode: the prefill worker's offered KV stays pinned until
         # the decode leg pulls it — signal the outcome so success stops the
-        # tracking and failure triggers reclamation (engine/kv_transfer.py)
+        # tracking and ANY failure from here on (including decode-worker
+        # selection) triggers reclamation (engine/kv_transfer.py)
         offer_uuid = (
             export["k"].get("transfer_uuid")
             if export.get("connector") == "transfer" else None
@@ -412,6 +394,29 @@ class Router:
             except Exception:
                 logger.warning("kv offer %s signal failed", offer_uuid)
 
+        try:
+            d_worker = policy.select_worker(decode_pool, ctx)
+            if d_worker is None:
+                raise RouteError(503, "no healthy decode workers", "service_unavailable")
+            if (
+                export.get("connector") == "device"
+                and not d_worker.client.supports_device_kv
+            ):
+                # a host-only decode worker joined mid-flight: degrade the
+                # payload (device->host pull runs off the event loop — it can
+                # be tens of MB through a device transfer)
+                import numpy as np
+
+                loop = asyncio.get_running_loop()
+                export["k"], export["v"] = await loop.run_in_executor(
+                    None, lambda: (np.asarray(export["k"]), np.asarray(export["v"]))
+                )
+                export["connector"] = "host"
+        except BaseException:
+            await _signal(consumed=False)
+            raise
+        d_guard = d_worker.acquire()
+        finished_cleanly = False
         try:
             wreq = WorkerGenerateRequest(rid=rid, input_ids=input_ids, sampling=worker_sampling)
             async for chunk in d_worker.client.generate_prefilled(

@@ -434,6 +434,10 @@ def build_app(ctx: AppContext) -> web.Application:
     app.router.add_post("/rerank", h_rerank)  # reference alias (server.rs route table)
     app.router.add_post("/v1/classify", h_classify)
     app.router.add_post("/v1/messages", h_anthropic_messages)
+    app.router.add_post("/v1/audio/transcriptions", h_audio_transcriptions)
+    app.router.add_post("/v1/interactions", h_interactions)
+    app.router.add_get("/v1/interactions/{interaction_id}", h_interaction_get)
+    app.router.add_delete("/v1/interactions/{interaction_id}", h_interaction_delete)
     app.router.add_post("/parse/function_call", h_parse_function_call)
     app.router.add_post("/parse/reasoning", h_parse_reasoning)
     app.router.add_post("/v1/tokenize", h_tokenize)
@@ -1333,3 +1337,208 @@ async def h_workflow_resume(request: web.Request) -> web.Response:
         return _error(409, f"workflow {iid} is not resumable")
     inst = await ctx.workflows.wait(iid, timeout=120.0)
     return web.json_response(inst.describe())
+
+
+# ---- audio transcriptions + interactions (reference: server.rs:238-311) ----
+
+async def h_audio_transcriptions(request: web.Request) -> web.Response:
+    """OpenAI-compatible /v1/audio/transcriptions (multipart/form-data).
+
+    Routing parity with the reference: ASR runs on the worker, the gateway
+    parses the form and forwards to an OpenAI-compatible audio worker (the
+    HTTP proxy path).  Without one, the request fails with an explicit 501
+    rather than a silent wrong answer."""
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.transcription import TranscriptionRequest
+
+    if not (request.content_type or "").startswith("multipart/"):
+        return _error(400, "expected multipart/form-data with a 'file' part")
+    fields: dict = {}
+    granularities: list[str] = []
+    file_bytes = None
+    filename = "audio.wav"
+    file_ctype = "application/octet-stream"
+    reader = await request.multipart()
+    async for part in reader:
+        if part.name == "file":
+            file_bytes = await part.read(decode=False)
+            filename = part.filename or filename
+            file_ctype = part.headers.get("Content-Type") or file_ctype
+        elif part.name in ("timestamp_granularities[]", "timestamp_granularities"):
+            # repeated form parts accumulate (word AND segment)
+            granularities.append((await part.read(decode=False)).decode())
+        elif part.name:
+            fields[part.name] = (await part.read(decode=False)).decode()
+    if file_bytes is None:
+        return _error(400, "missing 'file' part")
+    try:
+        req = TranscriptionRequest.model_validate(
+            {**fields, "timestamp_granularities": granularities or None}
+        )
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+
+    router = ctx.router_for(req.model or None)
+    worker = router.select_proxy_worker(req.model or None)
+    if worker is None:
+        return _error(
+            501,
+            "no transcription-capable worker for this model; register an "
+            "OpenAI-compatible audio worker (POST /workers with an http:// url)",
+            "not_implemented",
+        )
+    async with ctx.semaphore:
+        guard = worker.acquire()
+        ok = False
+        try:
+            forward = dict(fields)
+            if granularities:
+                forward["timestamp_granularities[]"] = granularities
+            data = await worker.client.post_multipart(
+                "/v1/audio/transcriptions", forward,
+                file_bytes, filename=filename, content_type=file_ctype,
+            )
+            ok = True
+        except Exception as e:
+            status = getattr(e, "status", 502)
+            return _error(502 if status >= 500 else status,
+                          f"transcription worker error: {e}", "worker_error")
+        finally:
+            guard.release(success=ok)
+    if isinstance(data, str):
+        return web.Response(text=data, content_type="text/plain")
+    return web.json_response(data)
+
+
+async def h_interactions(request: web.Request) -> web.Response | web.StreamResponse:
+    """Interactions API: stateful chat-like surface with
+    previous_interaction_id chaining (reference: interactions.rs +
+    server.rs:238-250); served on the local token path."""
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.interactions import (
+        Interaction,
+        InteractionsRequest,
+        InteractionsUsage,
+        interaction_metadata,
+        text_output,
+    )
+    from smg_tpu.storage import StoredResponse
+
+    try:
+        req = InteractionsRequest.model_validate(await request.json())
+    except Exception as e:
+        return _error(400, f"invalid request: {e}")
+    model_id = req.model or req.agent
+    prior: list = []
+    if req.previous_interaction_id:
+        stored = await ctx.storage.get_response(req.previous_interaction_id)
+        if stored is None:
+            return _error(404, f"no interaction {req.previous_interaction_id}")
+        prior = stored.metadata.get("messages", [])
+    messages = req.to_messages(prior)
+    gen = req.generation_config
+    chat_req = ChatCompletionRequest(
+        model=model_id,
+        messages=messages,
+        temperature=gen.temperature if gen else None,
+        top_p=gen.top_p if gen else None,
+        top_k=gen.top_k if gen else None,
+        max_tokens=gen.max_output_tokens if gen else None,
+        stop=gen.stop_sequences if gen else None,
+        stream=req.stream,
+        # the final stream chunk carries usage so streamed interactions
+        # persist real token accounting, same as the blocking path
+        stream_options={"include_usage": True} if req.stream else None,
+    )
+    router = ctx.router_for(model_id)
+    rid = Interaction.new_id()
+
+    async def persist(text: str, usage: InteractionsUsage) -> None:
+        if not req.store:
+            return
+        await ctx.storage.store_response(StoredResponse(
+            id=rid,
+            previous_response_id=req.previous_interaction_id,
+            model=model_id or "",
+            output=[text_output(text)],
+            usage=usage.model_dump(),
+            metadata=interaction_metadata(req, messages, text),
+        ))
+
+    async with ctx.semaphore:
+        if not req.stream:
+            resp = await router.chat(chat_req, request_id=rid)
+            text = resp.choices[0].message.content or ""
+            usage = InteractionsUsage(
+                total_input_tokens=resp.usage.prompt_tokens,
+                total_output_tokens=resp.usage.completion_tokens,
+                total_tokens=resp.usage.total_tokens,
+            )
+            await persist(text, usage)
+            return web.json_response(Interaction(
+                id=rid, model=req.model, agent=req.agent,
+                created=Interaction.now_iso(),
+                outputs=[text_output(text)], usage=usage,
+                previous_interaction_id=req.previous_interaction_id,
+            ).model_dump(exclude_none=True))
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        parts: list[str] = []
+        usage = InteractionsUsage()
+        try:
+            async for chunk in router.chat_stream(chat_req, request_id=rid):
+                if chunk.usage is not None:
+                    usage = InteractionsUsage(
+                        total_input_tokens=chunk.usage.prompt_tokens,
+                        total_output_tokens=chunk.usage.completion_tokens,
+                        total_tokens=chunk.usage.total_tokens,
+                    )
+                delta = chunk.choices[0].delta.content if chunk.choices else None
+                if delta:
+                    parts.append(delta)
+                    ev = {"type": "content_delta", "interaction_id": rid,
+                          "delta": {"type": "text", "text": delta}}
+                    await sse.write(f"data: {json.dumps(ev)}\n\n".encode())
+            text = "".join(parts)
+            await persist(text, usage)
+            done = {"type": "interaction_complete", "interaction": Interaction(
+                id=rid, model=req.model, agent=req.agent,
+                created=Interaction.now_iso(), outputs=[text_output(text)],
+                usage=usage,
+                previous_interaction_id=req.previous_interaction_id,
+            ).model_dump(exclude_none=True)}
+            await sse.write(f"data: {json.dumps(done)}\n\n".encode())
+            await sse.write(b"data: [DONE]\n\n")
+        except RouteError as e:
+            err = {"type": "error", "error": {"message": e.message}}
+            await sse.write(f"data: {json.dumps(err)}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def h_interaction_get(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    from smg_tpu.protocols.interactions import Interaction, InteractionsUsage
+
+    iid = request.match_info["interaction_id"]
+    stored = await ctx.storage.get_response(iid)
+    if stored is None or stored.metadata.get("kind") != "interaction":
+        return _error(404, f"no interaction {iid}")
+    return web.json_response(Interaction(
+        id=stored.id, model=stored.model or None, status=stored.status,
+        outputs=stored.output,
+        usage=InteractionsUsage(**stored.usage) if stored.usage else None,
+        previous_interaction_id=stored.previous_response_id,
+    ).model_dump(exclude_none=True))
+
+
+async def h_interaction_delete(request: web.Request) -> web.Response:
+    ctx: AppContext = request.app["ctx"]
+    iid = request.match_info["interaction_id"]
+    stored = await ctx.storage.get_response(iid)
+    # same identity rule as GET: a Responses-API object is not deletable
+    # through the interactions surface
+    if stored is None or stored.metadata.get("kind") != "interaction":
+        return _error(404, f"no interaction {iid}")
+    await ctx.storage.delete_response(iid)
+    return web.json_response({"deleted": iid})

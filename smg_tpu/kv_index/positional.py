@@ -60,29 +60,65 @@ class PositionalIndexer:
                     self._blocks.pop(h, None)
 
     def match(self, token_ids: list[int]) -> dict[str, int]:
-        """Per-worker matched prefix length (in tokens) for this request."""
+        """Per-worker matched prefix length (in tokens) for this request.
+
+        Jump-search (reference: positional jump-search, event_tree.rs):
+        block chains are prefix-monotone — a worker holding depth ``d``
+        holds every shallower depth — so the deepest any-worker depth D* is
+        found by galloping + binary search with hashes computed LAZILY
+        (most requests match shallowly or not at all, so the rolling chain
+        is hashed to ~2·D* pages, not the whole prompt), and each worker's
+        exact depth is then a binary search over set membership.  Cost:
+        O(D*) hashing + O(W·log D*) lookups vs the old O(n_pages·W) walk.
+        """
         ps = self.page_size
         n_pages = len(token_ids) // ps
         if n_pages == 0 or not self._blocks:
             return {}
-        # rolling hash chain over full pages
         hashes: list[int] = []
-        parent = 0
-        for i in range(n_pages):
-            parent = chain_hash(parent, tuple(token_ids[i * ps : (i + 1) * ps]))
-            hashes.append(parent)
+
+        def hash_at(depth: int) -> int:  # 1-based; extends the chain lazily
+            while len(hashes) < depth:
+                i = len(hashes)
+                parent = hashes[-1] if hashes else 0
+                hashes.append(
+                    chain_hash(parent, tuple(token_ids[i * ps:(i + 1) * ps]))
+                )
+            return hashes[depth - 1]
+
+        def nonempty(depth: int) -> bool:
+            return bool(self._blocks.get(hash_at(depth)))
+
+        if not nonempty(1):
+            return {}
+        # gallop for an upper bound on the deepest any-worker depth
+        lo = 1
+        probe = 2
+        while probe <= n_pages and nonempty(probe):
+            lo = probe
+            probe *= 2
+        hi = min(probe - 1, n_pages)
+        # binary search the deepest nonempty depth in (lo, hi]
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if nonempty(mid):
+                lo = mid
+            else:
+                hi = mid - 1
+        deepest = lo
+        # exact per-worker depth: binary search membership in the worker's
+        # own block set (holders at depth 1 is the candidate superset)
         out: dict[str, int] = {}
-        # galloping from depth 0; most requests match shallowly or not at all
-        alive: set[str] | None = None
-        for depth, h in enumerate(hashes):
-            holders = self._blocks.get(h)
-            if not holders:
-                break
-            alive = holders if alive is None else (alive & holders)
-            if not alive:
-                break
-            for w in alive:
-                out[w] = (depth + 1) * ps
+        for w in self._blocks.get(hash_at(1), ()):
+            blocks = self._worker_blocks.get(w, ())
+            wlo, whi = 1, deepest
+            while wlo < whi:
+                mid = (wlo + whi + 1) // 2
+                if hash_at(mid) in blocks:
+                    wlo = mid
+                else:
+                    whi = mid - 1
+            out[w] = wlo * ps
         return out
 
     def stats(self) -> dict:

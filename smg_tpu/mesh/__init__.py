@@ -6,5 +6,17 @@ epoch-count merge, stream namespaces, partition detection (SURVEY.md §2.2).
 
 from smg_tpu.mesh.crdt import LwwMap
 from smg_tpu.mesh.gossip import GossipConfig, GossipNode
+from smg_tpu.mesh.partition import (
+    PartitionConfig,
+    PartitionDetector,
+    PartitionState,
+)
 
-__all__ = ["LwwMap", "GossipNode", "GossipConfig"]
+__all__ = [
+    "LwwMap",
+    "GossipNode",
+    "GossipConfig",
+    "PartitionConfig",
+    "PartitionDetector",
+    "PartitionState",
+]

@@ -43,7 +43,10 @@ class Member:
 
 
 class GossipNode:
-    def __init__(self, config: GossipConfig, state: LwwMap | None = None):
+    def __init__(self, config: GossipConfig, state: LwwMap | None = None,
+                 partition_config=None):
+        from smg_tpu.mesh.partition import PartitionDetector
+
         self.config = config
         self.node_id = config.node_id or f"node-{random.getrandbits(32):08x}"
         self.state = state or LwwMap(self.node_id)
@@ -51,6 +54,9 @@ class GossipNode:
         self._server: asyncio.Server | None = None
         self._task: asyncio.Task | None = None
         self.addr = ""
+        # partition classification over the membership view (reference:
+        # crates/mesh/src/partition.rs); refreshed every gossip round
+        self.partition = PartitionDetector(partition_config)
 
     # ---- lifecycle ----
 
@@ -147,6 +153,14 @@ class GossipNode:
                 raise
             except Exception:
                 logger.debug("gossip round failed", exc_info=True)
+            self.partition.detect(self)
+
+    @property
+    def has_quorum(self) -> bool:
+        """False only in a detected minority partition — HA adapters use
+        this to fence state-mutating sync (divergence bounded to the CRDT
+        merge window instead of split-brain writes)."""
+        return self.partition.has_quorum
 
     async def _round(self) -> None:
         peers = [

@@ -100,15 +100,21 @@ class InternVLImageProcessor(ImageProcessor):
 
     def _grid_for(self, h: int, w: int) -> tuple[int, int]:
         """Best (rows, cols) tiling with rows*cols <= max_tiles, closest to
-        the image's aspect ratio."""
+        the image's aspect ratio.  Ratio ties prefer MORE tiles only when
+        the image actually has the pixels to fill them (the InternVL recipe
+        gates tiling on area — a tiny square image must not be upscaled
+        into a 3x3 grid of near-identical tiles)."""
         best, best_diff = (1, 1), float("inf")
         ratio = w / h
+        area = h * w
         for rows in range(1, self.max_tiles + 1):
             for cols in range(1, self.max_tiles // rows + 1):
                 diff = abs(cols / rows - ratio)
-                if diff < best_diff or (
-                    diff == best_diff and rows * cols > best[0] * best[1]
-                ):
+                prefer_bigger = (
+                    rows * cols > best[0] * best[1]
+                    and area > 0.5 * rows * cols * self.tile_size ** 2
+                )
+                if diff < best_diff or (diff == best_diff and prefer_bigger):
                     best, best_diff = (rows, cols), diff
         return best
 

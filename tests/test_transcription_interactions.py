@@ -1,0 +1,243 @@
+"""Audio transcriptions (multipart proxy path) + Interactions API
+(reference: server.rs:238-311, crates/protocols/src/{transcription,
+interactions}.rs; VERDICT r3 missing #9)."""
+
+import asyncio
+import io
+import threading
+import wave
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient
+from smg_tpu.gateway.workers import Worker
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def _wav_bytes(secs=0.2, rate=16000):
+    t = np.arange(int(secs * rate)) / rate
+    x = (0.3 * np.sin(2 * np.pi * 440 * t) * 32767).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(x.tobytes())
+    return buf.getvalue()
+
+
+class FakeAsrWorker:
+    """OpenAI-compatible audio worker double: /v1/models + transcriptions."""
+
+    def __init__(self):
+        self.app = web.Application()
+        self.app.router.add_get("/v1/models", self.models)
+        self.app.router.add_post("/v1/audio/transcriptions", self.transcribe)
+        self.requests = []
+
+    async def models(self, request):
+        return web.json_response({"data": [{"id": "whisper-x"}]})
+
+    async def transcribe(self, request):
+        reader = await request.multipart()
+        fields, blob = {}, b""
+        async for part in reader:
+            if part.name == "file":
+                blob = await part.read(decode=False)
+            elif part.name:
+                fields[part.name] = (await part.read(decode=False)).decode()
+        self.requests.append((fields, len(blob)))
+        if fields.get("response_format") == "text":
+            return web.Response(text="hello from asr", content_type="text/plain")
+        return web.json_response({"text": "hello from asr",
+                                  "language": fields.get("language")})
+
+
+@pytest.fixture(scope="module")
+def stack():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=300):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=timeout)
+
+    eng = Engine(EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=2, max_seq_len=128, max_prefill_tokens=32,
+            prefill_token_buckets=(32,), decode_batch_buckets=(2,),
+        ),
+        dtype="float32", model_id="tiny-ia",
+    ), tokenizer=MockTokenizer())
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-ia", MockTokenizer(), default=True)
+    asr = FakeAsrWorker()
+
+    async def _setup():
+        runner = web.AppRunner(asr.app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        asr_port = site._server.sockets[0].getsockname()[1]
+        ctx.registry.add(Worker(worker_id="w0", client=InProcWorkerClient(eng),
+                                model_id="tiny-ia"))
+        from smg_tpu.gateway.http_worker import HttpWorkerClient
+
+        ctx.registry.add(Worker(
+            worker_id="asr0",
+            client=HttpWorkerClient(f"http://127.0.0.1:{asr_port}"),
+            model_id="whisper-x",
+        ))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return runner, tc
+
+    runner, tc = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.ctx, h.tc, h.asr = run, ctx, tc, asr
+    yield h
+    run(tc.close())
+    run(runner.cleanup())
+    loop.call_soon_threadsafe(loop.stop)
+    eng.stop()
+
+
+def _mp_form(**fields):
+    import aiohttp
+
+    form = aiohttp.FormData()
+    for k, v in fields.items():
+        form.add_field(k, v)
+    form.add_field("file", _wav_bytes(), filename="a.wav",
+                   content_type="audio/wav")
+    return form
+
+
+def test_transcription_proxies_to_audio_worker(stack):
+    h = stack
+
+    async def go():
+        r = await h.tc.post("/v1/audio/transcriptions",
+                            data=_mp_form(model="whisper-x", language="en"))
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["text"] == "hello from asr"
+    assert body["language"] == "en"
+    fields, blob_len = h.asr.requests[-1]
+    assert fields["model"] == "whisper-x" and blob_len > 1000
+
+
+def test_transcription_text_format(stack):
+    h = stack
+
+    async def go():
+        r = await h.tc.post("/v1/audio/transcriptions",
+                            data=_mp_form(model="whisper-x",
+                                          response_format="text"))
+        return r.status, await r.text(), r.content_type
+
+    status, text, ctype = h.run(go())
+    assert status == 200 and text == "hello from asr"
+    assert ctype == "text/plain"
+
+
+def test_transcription_501_without_audio_worker(stack):
+    h = stack
+
+    async def go():
+        r = await h.tc.post("/v1/audio/transcriptions",
+                            data=_mp_form(model="tiny-ia"))
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 501
+    assert "worker" in body["error"]["message"]
+
+
+def test_interactions_roundtrip_and_chaining(stack):
+    h = stack
+
+    async def go():
+        r1 = await h.tc.post("/v1/interactions", json={
+            "model": "tiny-ia", "input": "w5 w6",
+            "system_instruction": "w9",
+            "generation_config": {"temperature": 0, "max_output_tokens": 5},
+        })
+        b1 = await r1.json()
+        assert r1.status == 200, b1
+        # chained turn sees the prior context
+        r2 = await h.tc.post("/v1/interactions", json={
+            "model": "tiny-ia", "input": "w7",
+            "previous_interaction_id": b1["id"],
+            "generation_config": {"temperature": 0, "max_output_tokens": 4},
+        })
+        b2 = await r2.json()
+        assert r2.status == 200, b2
+        # retrieval + delete
+        rg = await h.tc.get(f"/v1/interactions/{b1['id']}")
+        bg = await rg.json()
+        rd = await h.tc.delete(f"/v1/interactions/{b1['id']}")
+        r404 = await h.tc.get(f"/v1/interactions/{b1['id']}")
+        return b1, b2, bg, rd.status, r404.status
+
+    b1, b2, bg, del_status, get404 = h.run(go())
+    assert b1["object"] == "interaction" and b1["id"].startswith("interaction_")
+    from smg_tpu.protocols.interactions import output_text
+
+    assert output_text(b1["outputs"])  # model text present
+    assert b1["usage"]["total_output_tokens"] == 5
+    assert b2["previous_interaction_id"] == b1["id"]
+    # chained prompt included turn 1 (usage grows beyond a single turn)
+    assert b2["usage"]["total_input_tokens"] > b1["usage"]["total_input_tokens"]
+    assert bg["id"] == b1["id"] and bg["outputs"] == b1["outputs"]
+    assert del_status == 200 and get404 == 404
+
+
+def test_interactions_streaming(stack):
+    h = stack
+
+    async def go():
+        r = await h.tc.post("/v1/interactions", json={
+            "model": "tiny-ia", "input": "w5",
+            "stream": True,
+            "generation_config": {"temperature": 0, "max_output_tokens": 4},
+        })
+        return r.status, await r.text()
+
+    status, raw = h.run(go())
+    assert status == 200
+    import json as _json
+
+    frames = [_json.loads(l[6:]) for l in raw.splitlines()
+              if l.startswith("data: ") and l[6:] != "[DONE]"]
+    deltas = [f for f in frames if f["type"] == "content_delta"]
+    assert deltas and all(f["delta"]["text"] for f in deltas)
+    final = [f for f in frames if f["type"] == "interaction_complete"]
+    assert final and final[0]["interaction"]["outputs"]
+    assert raw.rstrip().endswith("data: [DONE]")
+
+
+def test_interactions_validation(stack):
+    h = stack
+
+    async def go():
+        r = await h.tc.post("/v1/interactions", json={"input": "w5"})
+        return r.status
+
+    assert h.run(go()) == 400  # neither model nor agent
