@@ -114,7 +114,8 @@ class Engine:
         if mm_embeds is not None:
             import numpy as np
 
-            embeds, positions = mm_embeds
+            embeds, positions, *rest = mm_embeds
+            grids = rest[0] if rest else None  # per-image merged (gh, gw)
             embeds = np.asarray(embeds, np.float32)
             positions = np.asarray(positions, np.int64)
             if positions.size and (positions.min() < 0
@@ -123,6 +124,25 @@ class Engine:
             if embeds.shape[0] != positions.shape[0]:
                 raise ValueError("mm_embeds embeds/positions length mismatch")
             req.mm_embeds = (embeds, positions)
+            if grids and self.config.model.mrope_section is not None:
+                # Qwen2-VL M-RoPE: 3-axis position ids per token + the
+                # decode delta (engine/mrope.py)
+                if self.runner.use_pp:
+                    # reject HERE — deep in the step loop the error would
+                    # wedge an admitted request in its slot forever
+                    raise ValueError(
+                        "M-RoPE image requests are not supported with "
+                        "serving pp yet"
+                    )
+                from smg_tpu.engine.mrope import (
+                    image_runs_from_positions,
+                    mrope_positions,
+                )
+
+                runs = image_runs_from_positions(positions, grids)
+                req.mrope_pos, req.mrope_delta = mrope_positions(
+                    len(prompt_ids), runs
+                )
         if self.tokenizer is not None:
             req.detok = IncrementalDecoder(
                 self.tokenizer, skip_special_tokens=sampling.skip_special_tokens

@@ -57,8 +57,13 @@ class EngineRequest:
     # (embeds [M, E] float32, positions [M] int32).  Reference: the EPD
     # encode leg ships vision-tower output to prefill (``stages/encode.rs``).
     mm_embeds: tuple | None = None
-    # per-page content-hash salts for radix keying (scheduler-computed)
-    mm_extra_keys: "list[int] | None" = None
+    # radix-key salt cache: (n_tokens_covered, per-page salts) —
+    # scheduler-computed (see Scheduler._mm_extra_keys)
+    mm_extra_keys: "tuple | None" = None
+    # M-RoPE (Qwen2-VL): per-token [3, prompt_len] position ids + the decode
+    # position delta (engine/mrope.py); None = standard rope
+    mrope_pos: Any = None
+    mrope_delta: int = 0
 
     @property
     def prompt_len(self) -> int:

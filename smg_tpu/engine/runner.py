@@ -369,10 +369,11 @@ class ModelRunner:
 
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
                     use_mask: bool = False, use_lora: bool = False,
-                    use_ring: bool = False, use_embeds: bool = False):
+                    use_ring: bool = False, use_embeds: bool = False,
+                    use_mrope: bool = False):
         impl = "xla" if use_ring else self._prefill_impl_for(mp)
         k = ("prefill", T, mp, impl, use_pen, use_mask, use_lora, use_ring,
-             use_embeds)
+             use_embeds, use_mrope)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -399,12 +400,17 @@ class ModelRunner:
             input_embeds = embeds_mask = None
             if use_embeds:
                 input_embeds, embeds_mask = extra[i], extra[i + 1]
+                i += 2
+            rope_pos = None
+            if use_mrope:
+                rope_pos = extra[i]
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc, page_table,
                 lora=lora_bank, lora_gates=lora_gates, sp_mesh=sp_mesh,
                 attn_impl=impl,
                 input_embeds=input_embeds, embeds_mask=embeds_mask,
                 pp_mesh=pp_mesh,
+                rope_pos=rope_pos,
             )
             logits = logits[None]
             if use_pen:
@@ -413,7 +419,8 @@ class ModelRunner:
             return toks[0], lps[0], kc, vc
 
         n_extra = ((5 if use_pen else 0) + (1 if use_mask else 0)
-                   + (2 if use_lora else 0) + (2 if use_embeds else 0))
+                   + (2 if use_lora else 0) + (2 if use_embeds else 0)
+                   + (1 if use_mrope else 0))
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -581,7 +588,7 @@ class ModelRunner:
 
     def _decode_multi_fn(self, B: int, mp: int, N: int,
                          use_pen: bool = False, use_mask: bool = False,
-                         use_lora: bool = False):
+                         use_lora: bool = False, use_mrope: bool = False):
         """N decode steps fused into one jitted lax.scan: sampled tokens feed
         back on-device, so host round trips amortize N-fold (the decisive win
         when dispatch latency rivals step compute).  Overshoot past a
@@ -593,8 +600,10 @@ class ModelRunner:
         sampled, so penalties stay exact across the horizon).  ``use_mask``
         adds a [B, V] constrained-decoding vocab mask; the scheduler forces
         N=1 for masked batches since the mask is host-derived per token.
-        ``use_lora`` adds the adapter bank + per-slot adapter indices."""
-        k = ("decode_multi", B, mp, N, use_pen, use_mask, use_lora)
+        ``use_lora`` adds the adapter bank + per-slot adapter indices.
+        ``use_mrope`` adds a [B] rope position delta (M-RoPE decode: text
+        axes are equal, so the offset rides the standard rope path)."""
+        k = ("decode_multi", B, mp, N, use_pen, use_mask, use_lora, use_mrope)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -620,6 +629,8 @@ class ModelRunner:
             if use_lora:
                 lora_bank, lora_idx = extra[i], extra[i + 1]
                 lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
+                i += 2
+            rope_delta = extra[i] if use_mrope else None
             keys = jax.random.split(key, N)
             cache_dtype = kc.dtype
             hk = jnp.zeros((L, B, N, KD), cache_dtype)
@@ -635,6 +646,7 @@ class ModelRunner:
                     kc, vc, page_tables, hk, hv, attn_impl=attn_impl,
                     lora=lora_bank, lora_gates=lora_gates,
                     pp_mesh=(self.mesh if self.use_pp else None),
+                    rope_delta=rope_delta,
                 )
                 if use_pen:
                     logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
@@ -669,7 +681,8 @@ class ModelRunner:
                 return outs.T, lps.T, kc, vc, counts_buf
             return outs.T, lps.T, kc, vc  # [B, N]
 
-        n_extra = (6 if use_pen else 0) + (1 if use_mask else 0) + (2 if use_lora else 0)
+        n_extra = ((6 if use_pen else 0) + (1 if use_mask else 0)
+                   + (2 if use_lora else 0) + (1 if use_mrope else 0))
         donate = (4, 5) + ((12,) if use_pen else ())
         if self.mesh is not None:
             r = self._replicated
@@ -699,13 +712,18 @@ class ModelRunner:
         pen: tuple | None = None,  # (slot_idx [B], freqs [B], pres [B], reps [B])
         mask: np.ndarray | None = None,  # [B, V] bool
         lora_idx: np.ndarray | None = None,  # [B] adapter slot per row (0 = none)
+        rope_delta: np.ndarray | None = None,  # [B] M-RoPE decode offsets
     ) -> tuple[np.ndarray, np.ndarray]:
         """Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
         B, mp = page_tables.shape
         use_pen = pen is not None
         use_mask = mask is not None
         use_lora = lora_idx is not None and self._lora_bank is not None
-        fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask, use_lora)
+        use_mrope = rope_delta is not None
+        if use_mrope and self.use_pp:
+            raise ValueError("M-RoPE does not compose with serving pp yet")
+        fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask, use_lora,
+                                   use_mrope)
         args = [
             self.params,
             self.inv_freq,
@@ -735,6 +753,8 @@ class ModelRunner:
             args.append(jnp.asarray(mask))
         if use_lora:
             args += [self._lora_bank, jnp.asarray(lora_idx, jnp.int32)]
+        if use_mrope:
+            args.append(jnp.asarray(rope_delta, jnp.int32))
         out = fn(*args)
         if use_pen:
             toks, lps, self.k_cache, self.v_cache, self._counts_buf = out
@@ -786,6 +806,7 @@ class ModelRunner:
         mask: np.ndarray | None = None,  # [V] bool
         lora_idx: int = 0,  # adapter slot (0 = none)
         mm: tuple | None = None,  # (embeds [t, E] f32, emask [t] bool) mm splice
+        rope_pos: "np.ndarray | None" = None,  # [3, t] M-RoPE position ids
     ) -> tuple[int, float]:
         """Run one prefill chunk; returns (sampled_token, logprob)."""
         t = len(token_ids)
@@ -813,9 +834,12 @@ class ModelRunner:
             self.mesh is not None and sp > 1 and prefix_len == 0 and T % sp == 0
             and not self.use_pp  # ring + pp composition is future work
         )
+        if rope_pos is not None and (self.use_pp or use_ring):
+            raise ValueError("M-RoPE does not compose with pp/ring prefill yet")
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
                               use_mask=mask is not None, use_lora=use_lora,
-                              use_ring=use_ring, use_embeds=mm is not None)
+                              use_ring=use_ring, use_embeds=mm is not None,
+                              use_mrope=rope_pos is not None)
         args = [
             self.params,
             self.inv_freq,
@@ -851,6 +875,10 @@ class ModelRunner:
             pm = np.zeros(T, bool)
             pm[:t] = emask
             args += [jnp.asarray(pe), jnp.asarray(pm)]
+        if rope_pos is not None:
+            rp = np.zeros((3, T), np.int32)
+            rp[:, :t] = rope_pos
+            args.append(jnp.asarray(rp))
         tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
 

@@ -162,7 +162,8 @@ class Scheduler:
                 node = None
                 if self.radix is not None:
                     shared_pages, node = self.radix.match_prefix(
-                        prompt[:-1], extra_keys=self._mm_extra_keys(req)
+                        prompt[:-1],
+                        extra_keys=self._mm_extra_keys(req, len(prompt)),
                     )
                 matched_tokens = len(shared_pages) * self.ps
                 prompt_pages_total = math.ceil(len(prompt) / self.ps)
@@ -191,9 +192,10 @@ class Scheduler:
 
                 remaining = len(prompt) - matched_tokens
                 if (remaining > self.sched.max_prefill_tokens
-                        or getattr(self.runner, "use_pp", False)):
-                    # pp serving: grouped prefill isn't pp-wired yet, run
-                    # every prompt through the (pp-capable) solo chunk loop
+                        or getattr(self.runner, "use_pp", False)
+                        or req.mrope_pos is not None):
+                    # pp serving + M-RoPE requests: grouped prefill isn't
+                    # wired for either yet — use the solo chunk loop
                     self._prefill_solo(req, prompt, matched_tokens, outputs)
                 else:
                     # mm requests batch like text: the group path splices
@@ -251,37 +253,78 @@ class Scheduler:
                 mask=mask,
                 lora_idx=req.lora_idx,
                 mm=self._mm_chunk(req, start, len(chunk)),
+                rope_pos=self._mrope_chunk(req, start, len(chunk)),
             )
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)
         req.seq_len = len(prompt)
         self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
 
-    def _mm_extra_keys(self, req: EngineRequest) -> "list[int] | None":
+    def _mrope_chunk(self, req: EngineRequest, start: int, n: int):
+        """[3, n] M-RoPE ids for one prefill chunk.  Positions past the
+        prompt (re-prefill after preemption re-runs generated tokens) are
+        text: all three axes = sequence position + delta."""
+        if req.mrope_pos is None:
+            return None
+        idx = np.arange(start, start + n)
+        out = np.broadcast_to(
+            (idx + req.mrope_delta)[None, :], (3, n)
+        ).astype(np.int32).copy()
+        pl = req.mrope_pos.shape[1]
+        within = idx < pl
+        if within.any():
+            out[:, within] = req.mrope_pos[:, idx[within]]
+        return out
+
+    def _mm_extra_keys(
+        self, req: EngineRequest, n_tokens: int | None = None
+    ) -> "list[int] | None":
         """Per-page mm content salts for radix keying (reference: extra keys
         mixed into block hashes).  Page p's salt digests the embedding rows
         and in-page offsets of every placeholder position the page covers;
-        0 = page has no mm content.  Computed once per request."""
+        0 = page has no mm content.
+
+        ``n_tokens`` extends coverage past the prompt — insert at finish
+        covers generated-token pages, whose rope positions under M-RoPE are
+        shifted by the delta and therefore must not alias plain-rope chains
+        with the same token ids (nor insert unsalted pages a later M-RoPE
+        turn can't re-match)."""
         if req.mm_embeds is None:
             return None
-        if req.mm_extra_keys is not None:
-            return req.mm_extra_keys
+        if n_tokens is None:
+            n_tokens = len(req.prompt_ids)
+        cached = req.mm_extra_keys
+        if cached is not None and cached[0] == n_tokens:
+            return cached[1]
         import hashlib
 
         embeds, positions = req.mm_embeds
-        n_pages = math.ceil(len(req.prompt_ids) / self.ps)
+        n_pages = math.ceil(n_tokens / self.ps)
         keys = [0] * n_pages
         order = np.argsort(positions)
         for p in range(n_pages):
             lo, hi = p * self.ps, (p + 1) * self.ps
             sel = order[(positions[order] >= lo) & (positions[order] < hi)]
-            if sel.size == 0:
+            # KV also depends on rope position ids: under M-RoPE every page
+            # whose ids deviate from the sequential arange (the image pages
+            # and everything after them — generated positions carry the
+            # delta) must salt its hash
+            mr = None
+            if req.mrope_pos is not None:
+                mslice = self._mrope_chunk(req, lo, min(hi, n_tokens) - lo)
+                seq = np.arange(lo, lo + mslice.shape[1], dtype=mslice.dtype)
+                if not (mslice == seq[None, :]).all():
+                    mr = mslice
+            if sel.size == 0 and mr is None:
                 continue
             h = hashlib.blake2b(digest_size=8)
             h.update(np.ascontiguousarray(positions[sel] - lo).tobytes())
             h.update(np.ascontiguousarray(embeds[sel], np.float32).tobytes())
+            if mr is not None:
+                h.update(b"mrope")
+                h.update(np.ascontiguousarray(mr).tobytes())
             keys[p] = int.from_bytes(h.digest(), "little") or 1
-        req.mm_extra_keys = keys
+        req.mm_extra_keys = (n_tokens, keys)
         return keys
 
     def _mm_chunk(self, req: EngineRequest, start: int, chunk_len: int):
@@ -371,6 +414,7 @@ class Scheduler:
         use_mask = any(r.token_filter is not None for _, r in active)
         use_pen = any(r.sampling.has_penalties for _, r in active)
         use_lora = any(r.lora_idx for _, r in active)
+        use_mrope = any(r.mrope_delta for _, r in active)
         horizon = 1 if use_mask else max(self.sched.decode_horizon, 1)
         # ensure pages exist for the whole horizon's KV writes; may preempt
         survivors = []
@@ -411,9 +455,12 @@ class Scheduler:
         reps = np.ones(B, np.float32)
         lora_idx = np.zeros(B, np.int32) if use_lora else None
         mask_arr = np.ones((B, V), bool) if use_mask else None
+        rope_delta = np.zeros(B, np.int32) if use_mrope else None
         for idx, (slot, req) in enumerate(active):
             tokens[idx] = req.output_ids[-1]
             positions[idx] = req.seq_len
+            if use_mrope:
+                rope_delta[idx] = req.mrope_delta
             page_tables[idx] = self.page_tables[slot][:mp_b]
             sp = req.sampling
             temps[idx] = sp.temperature
@@ -444,6 +491,7 @@ class Scheduler:
             pen=(slot_idx, freqs, pres, reps) if use_pen else None,
             mask=mask_arr,
             lora_idx=lora_idx,
+            rope_delta=rope_delta,
         )
         self.num_decode_tokens += B_real * horizon
         for idx, (slot, req) in enumerate(active):
@@ -646,7 +694,7 @@ class Scheduler:
             # prompt get 0 via the key helper's bounds guard)
             dupes = self.radix.insert(
                 tokens, all_pages[:full_pages],
-                extra_keys=self._mm_extra_keys(req),
+                extra_keys=self._mm_extra_keys(req, len(tokens)),
             )
             for idx, page in dupes:
                 if idx >= n_shared:

@@ -560,7 +560,9 @@ class Router:
                     f"{pimg.num_placeholder_tokens} placeholder tokens",
                     "worker_error",
                 )
-            return np.asarray(e, np.float32), pimg.num_placeholder_tokens
+            # the processor owns the geometry: llm_grid is set only when
+            # the placeholder run really is a planar grid (M-RoPE input)
+            return np.asarray(e, np.float32), pimg.num_placeholder_tokens, pimg.llm_grid
 
         session = None
         try:
@@ -598,8 +600,9 @@ class Router:
         finally:
             if session is not None:
                 await session.close()
-        embeds = [e for e, _ in results]
-        counts = [c for _, c in results]
+        embeds = [e for e, _, _ in results]
+        counts = [c for _, c, _ in results]
+        grids = [g for _, _, g in results]
 
         flat = flatten_content(messages, placeholder)
         tools = [t.model_dump(exclude_none=True) for t in req.tools] if req.tools else None
@@ -620,6 +623,9 @@ class Router:
             raise RouteError(400, str(e))
         sampling = req.to_sampling_params(self.config.default_max_tokens)
         mm = (np.concatenate(embeds, axis=0), np.asarray(positions, np.int64))
+        if all(g is not None for g in grids):
+            # merged grids ride along for M-RoPE-capable workers
+            mm = mm + (grids,)
         return tokenizer, prompt_text, input_ids, sampling, mm
 
     async def chat(self, req: ChatCompletionRequest, request_id: str | None = None):

@@ -39,6 +39,15 @@ class ModelConfig:
     vision: "object | None" = None  # VisionConfig (kept loose: frozen dataclass)
     image_token_id: int | None = None
 
+    @property
+    def mrope_section(self) -> "tuple[int, ...] | None":
+        """Qwen2-VL M-RoPE frequency split (t, h, w) from rope_scaling; None
+        = standard rope (engine/mrope.py)."""
+        if not self.rope_scaling:
+            return None
+        sec = self.rope_scaling.get("mrope_section")
+        return tuple(sec) if sec else None
+
     @classmethod
     def from_hf_config(cls, cfg: dict, dtype: str = "bfloat16") -> "ModelConfig":
         arch_names = cfg.get("architectures") or ["LlamaForCausalLM"]
@@ -173,6 +182,17 @@ def tiny_vlm_config() -> ModelConfig:
         base,
         vision=tiny_vision_config(out_hidden_size=base.hidden_size),
         image_token_id=500,
+    )
+
+
+def tiny_vlm_mrope_config() -> ModelConfig:
+    """Tiny VLM with Qwen2-VL M-RoPE enabled (head_dim 16 -> D/2 = 8 =
+    2+3+3 frequency sections)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        tiny_vlm_config(),
+        rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
     )
 
 

@@ -210,6 +210,7 @@ def forward_prefill(
     input_embeds: jnp.ndarray | None = None,  # [T, E] mm splice rows
     embeds_mask: jnp.ndarray | None = None,  # [T] bool: row comes from input_embeds
     pp_mesh=None,  # Mesh: serving pipeline parallelism over the "pp" axis
+    rope_pos: jnp.ndarray | None = None,  # [3, T] M-RoPE position ids
 ):
     """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache).
 
@@ -256,8 +257,16 @@ def forward_prefill(
                 (layer, l), lor = xs, None
             hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
             q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)
-            q = apply_rope(q, pos, inv_freq)
-            k = apply_rope(k, pos, inv_freq)
+            if rope_pos is not None:
+                # M-RoPE: 3-axis ids rotate sectioned frequencies; masking
+                # and cache destinations keep the sequential ``pos``
+                from smg_tpu.ops.rope import apply_mrope
+
+                q = apply_mrope(q, rope_pos, inv_freq, cfg.mrope_section)
+                k = apply_mrope(k, rope_pos, inv_freq, cfg.mrope_section)
+            else:
+                q = apply_rope(q, pos, inv_freq)
+                k = apply_rope(k, pos, inv_freq)
             k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
             if sp_mesh is not None:
                 from smg_tpu.parallel.ring_attention import ring_attention
@@ -481,6 +490,7 @@ def forward_decode_horizon(
     lora: Params | None = None,
     lora_gates: jnp.ndarray | None = None,  # [B, n_adapters] one-hot per slot
     pp_mesh=None,  # Mesh: serving pipeline parallelism over the "pp" axis
+    rope_delta: jnp.ndarray | None = None,  # [B] M-RoPE decode offset per slot
 ):
     """One decode step against a frozen cache + growing side buffer.
 
@@ -501,6 +511,14 @@ def forward_decode_horizon(
 
     def make_body(positions, step_idx, entry_positions, page_tables, inv_freq,
                   k_cache, v_cache):
+        # generated tokens are text: all three M-RoPE axes are equal, so
+        # decode stays on the standard rope path with a per-slot offset.
+        # Computed from make_body's own params so the pp shard_map never
+        # closes over an outer tracer (rope_delta is rejected under pp).
+        rope_positions = (
+            positions if rope_delta is None else positions + rope_delta
+        )
+
         def layer_body(carry, xs):
             h, hk_all, hv_all = carry
             if lora is not None:
@@ -509,8 +527,8 @@ def forward_decode_horizon(
                 (layer, l), lor = xs, None
             hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
             q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [B, H/K, D]
-            q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-            k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+            q = apply_rope(q[:, None], rope_positions[:, None], inv_freq)[:, 0]
+            k = apply_rope(k[:, None], rope_positions[:, None], inv_freq)[:, 0]
             k_f = k.reshape(B, K * D).astype(hk_all.dtype)
             v_f = v.reshape(B, K * D).astype(hv_all.dtype)
             hk_all = jax.lax.dynamic_update_slice(

@@ -25,6 +25,10 @@ class ProcessedImage:
     pixel_values: jnp.ndarray  # [n_patches, patch_dim]
     grid: tuple[int, int]  # (gh, gw) patch grid
     num_placeholder_tokens: int
+    # merged LLM-token grid (gh_m, gw_m) — set ONLY by processors whose
+    # placeholder run is a planar spatial grid (drives M-RoPE); None for
+    # tiled/stacked geometries where a 2D grid would be a lie
+    llm_grid: "tuple[int, int] | None" = None
 
 
 class ImageProcessor:
@@ -56,9 +60,11 @@ class Qwen2VLImageProcessor(ImageProcessor):
         img = resize_image(img, h2, w2)
         img = normalize_image(img)
         patches, grid = patchify(img, self.patch_size)
-        merged = grid[0] // self.merge_size * (grid[1] // self.merge_size)
+        mgh, mgw = grid[0] // self.merge_size, grid[1] // self.merge_size
         return ProcessedImage(
-            pixel_values=patches, grid=grid, num_placeholder_tokens=merged
+            pixel_values=patches, grid=grid,
+            num_placeholder_tokens=mgh * mgw,
+            llm_grid=(mgh, mgw),
         )
 
 

@@ -40,8 +40,36 @@ def apply_rope(
     inv_freq: [D/2]
     """
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    return _rotate_half(x, angles)
+
+
+def _rotate_half(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
+    """Shared rotate-half application (HF convention): x [..., T, H, D],
+    angles [..., T, D/2]."""
     cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
     sin = jnp.sin(angles)[..., None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def apply_mrope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,  # [3, T] (temporal, height, width) position ids
+    inv_freq: jnp.ndarray,   # [D/2]
+    section: tuple,          # frequencies per axis; sum == D/2 (static)
+) -> jnp.ndarray:
+    """Multimodal rotary embedding (Qwen2-VL M-RoPE).
+
+    The D/2 frequency slots partition into three sections —
+    ``section = (t, h, w)`` — and each section's angle uses the matching
+    position row.  Text tokens carry three equal ids, which makes this
+    EXACTLY ``apply_rope`` for text-only sequences (the parity the engine
+    relies on to keep text requests on the standard path).
+    x: [T, H, D].
+    """
+    import numpy as np
+
+    sel = np.repeat(np.arange(3), np.asarray(section, np.int64))  # [D/2] static
+    pos_f = positions.astype(jnp.float32).T[:, sel]  # [T, D/2]
+    return _rotate_half(x, pos_f * inv_freq)

@@ -68,28 +68,34 @@ def sampling_from_proto(msg: pb.SamplingParamsProto) -> SamplingParams:
 
 
 def mm_embeds_to_proto(mm: "tuple | None") -> pb.MmEmbedsProto | None:
-    """(embeds [M, E] f32, positions [M]) -> MmEmbedsProto (None passes
-    through).  Rows > 0 signals presence on the wire (proto3 has no
-    has-field for messages constructed empty)."""
+    """(embeds [M, E] f32, positions [M][, grids]) -> MmEmbedsProto (None
+    passes through).  Rows > 0 signals presence on the wire (proto3 has no
+    has-field for messages constructed empty).  ``grids`` — optional
+    per-image merged (gh, gw) — feed M-RoPE on the worker."""
     if mm is None:
         return None
     import numpy as np
 
-    embeds, positions = mm
+    embeds, positions, *rest = mm
+    grids = rest[0] if rest else None
     embeds = np.ascontiguousarray(np.asarray(embeds, np.float32))
     if embeds.ndim != 2:
         raise ValueError(f"mm embeds must be [rows, cols], got {embeds.shape}")
-    return pb.MmEmbedsProto(
+    msg = pb.MmEmbedsProto(
         embeds=embeds.tobytes(),
         rows=embeds.shape[0],
         cols=embeds.shape[1],
         positions=[int(p) for p in positions],
     )
+    if grids:
+        msg.grid_hs.extend(int(g[0]) for g in grids)
+        msg.grid_ws.extend(int(g[1]) for g in grids)
+    return msg
 
 
 def mm_embeds_from_proto(msg: pb.MmEmbedsProto) -> "tuple | None":
-    """MmEmbedsProto -> (embeds [M, E] f32, positions [M]) or None when the
-    field was absent/empty (rows == 0)."""
+    """MmEmbedsProto -> (embeds [M, E] f32, positions [M][, grids]) or None
+    when the field was absent/empty (rows == 0)."""
     if msg is None or msg.rows == 0:
         return None
     import numpy as np
@@ -97,7 +103,11 @@ def mm_embeds_from_proto(msg: pb.MmEmbedsProto) -> "tuple | None":
     embeds = np.frombuffer(msg.embeds, dtype=np.float32).reshape(
         msg.rows, msg.cols
     )
-    return embeds, np.asarray(list(msg.positions), np.int64)
+    positions = np.asarray(list(msg.positions), np.int64)
+    if msg.grid_hs:
+        grids = list(zip(msg.grid_hs, msg.grid_ws))
+        return embeds, positions, grids
+    return embeds, positions
 
 
 def kv_batch_to_proto(batch: KvEventBatch) -> pb.KvEventBatchProto:
