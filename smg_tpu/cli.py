@@ -92,6 +92,10 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--kv-dtype", default=None, dest="kv_dtype",
                    choices=["bfloat16", "float32", "float16", "int8"],
                    help="KV cache dtype (default: follow --dtype)")
+    g.add_argument("--speculative", action="store_true",
+                   help="prompt-lookup speculative decoding for greedy "
+                        "requests (token-identical output)")
+    g.add_argument("--spec-max-draft", type=int, default=8, dest="spec_max_draft")
     g.add_argument("--max-batch-size", type=int, default=64)
     g.add_argument("--max-seq-len", type=int, default=8192)
     g.add_argument("--page-size", type=int, default=16)

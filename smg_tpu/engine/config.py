@@ -85,6 +85,13 @@ class SchedulerConfig:
     # single-chunk prompts admitted together in one batched prefill call
     # (fills the MXU and amortizes dispatch; long prompts still chunk solo)
     max_prefill_group: int = 8
+    # speculative decoding (prompt-lookup drafting, engine/speculative.py):
+    # greedy requests verify up to spec_max_draft n-gram-proposed tokens in
+    # one forward.  Token-identical to plain greedy decode.
+    speculative: bool = False
+    spec_max_draft: int = 8
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
 
     def __post_init__(self) -> None:
         if self.max_batch_size > max(self.decode_batch_buckets):

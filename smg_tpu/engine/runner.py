@@ -882,6 +882,74 @@ class ModelRunner:
         tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
 
+    def _verify_fn(self, T: int, mp: int, use_mrope: bool = False):
+        """Speculative verify: one prefill-shaped forward returning the
+        greedy argmax at EVERY chunk position (engine/speculative.py) —
+        K draft tokens scored in one MXU pass instead of K decode steps."""
+        impl = self._prefill_impl_for(mp)
+        k = ("verify", T, mp, impl, use_mrope)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+
+        def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc,
+                 page_table, *extra):
+            rope_pos = extra[0] if use_mrope else None
+            logits, kc, vc = module.forward_prefill(
+                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc,
+                page_table, attn_impl=impl, rope_pos=rope_pos,
+                all_logits=True,
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
+
+        if self.mesh is not None:
+            r = self._replicated
+            in_sh = (self.param_shardings, r, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r)
+            in_sh = in_sh + ((r,) if use_mrope else ())
+            fn = jax.jit(step, in_shardings=in_sh,
+                         out_shardings=(r, self.kv_sharding, self.kv_sharding),
+                         donate_argnums=(5, 6))
+        else:
+            fn = jax.jit(step, donate_argnums=(5, 6))
+        self._compiled[k] = fn
+        return fn
+
+    def verify(
+        self,
+        token_ids: "list[int]",
+        prefix_len: int,
+        page_table: np.ndarray,
+        rope_pos: "np.ndarray | None" = None,
+    ) -> np.ndarray:
+        """Greedy argmax after each of ``token_ids`` fed at positions
+        ``prefix_len..`` (KV for all fed tokens is written — overshoot past
+        the accepted seq_len is garbage-by-convention)."""
+        t = len(token_ids)
+        T = self.config.scheduler.prefill_bucket(t)
+        ps = self.config.cache.page_size
+        mp = len(page_table)
+        if prefix_len + t > mp * ps:
+            raise ValueError("verify chunk overruns page table")
+        if self.use_pp:
+            raise ValueError("speculative verify under serving pp is future work")
+        tokens = np.zeros(T, np.int32)
+        tokens[:t] = token_ids
+        fn = self._verify_fn(T, mp, use_mrope=rope_pos is not None)
+        args = [
+            self.params, self.inv_freq, jnp.asarray(tokens),
+            jnp.int32(prefix_len), jnp.int32(t),
+            self.k_cache, self.v_cache,
+            jnp.asarray(page_table, jnp.int32),
+        ]
+        if rope_pos is not None:
+            rp = np.zeros((3, T), np.int32)
+            rp[:, :t] = rope_pos
+            args.append(jnp.asarray(rp))
+        arg, self.k_cache, self.v_cache = fn(*args)
+        return np.asarray(arg)[:t]
+
     def decode(
         self,
         tokens: np.ndarray,  # [B] int32

@@ -57,6 +57,9 @@ class Scheduler:
         # counters for GetLoads / metrics
         self.num_prefill_tokens = 0
         self.num_decode_tokens = 0
+        # speculative decoding acceptance telemetry (engine/speculative.py)
+        self.num_spec_drafted = 0
+        self.num_spec_accepted = 0
         self.num_preemptions = 0
 
     # ---- public API ----
@@ -99,6 +102,8 @@ class Scheduler:
         return {
             "num_waiting": len(self.waiting),
             "num_running": running,
+            "spec_drafted": self.num_spec_drafted,
+            "spec_accepted": self.num_spec_accepted,
             "free_pages": self.pool.free_count,
             "cached_pages": self.radix.num_cached_pages if self.radix else 0,
             "total_pages": self.runner.spec.num_pages,
@@ -409,6 +414,10 @@ class Scheduler:
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return
+        if self.sched.speculative:
+            active = self._decode_speculative(active, outputs)
+            if not active:
+                return
         # constrained requests need a fresh host-derived vocab mask per token,
         # so a batch containing one collapses the horizon to single-step
         use_mask = any(r.token_filter is not None for _, r in active)
@@ -502,6 +511,65 @@ class Scheduler:
                 outputs,
                 advance_seq=True,
             )
+
+    def _decode_speculative(self, active, outputs: list[StepOutput]):
+        """Run spec-eligible slots through draft+verify; returns the slots
+        the normal batched decode should still handle.
+
+        Eligible = greedy, unconstrained, penalty-free, no logprobs (the
+        verify pass scores argmaxes only).  Each verify feeds
+        [last_token, drafts...] as one prefill-shaped forward and accepts
+        the longest matching prefix + the model's own next token — >= 1
+        token per call, so speculation never loses to plain decode on
+        steps, only on per-step cost (one bucket-T forward vs one decode)."""
+        from smg_tpu.engine.speculative import (
+            SpecConfig,
+            accept_greedy,
+            propose_ngram,
+        )
+
+        cfg = SpecConfig(
+            enabled=True,
+            max_draft=self.sched.spec_max_draft,
+            ngram_max=self.sched.spec_ngram_max,
+            ngram_min=self.sched.spec_ngram_min,
+        )
+        rest = []
+        for slot, req in active:
+            sp = req.sampling
+            eligible = (
+                sp.temperature == 0.0
+                and req.token_filter is None
+                and not sp.has_penalties
+                and not sp.logprobs
+                and not req.lora_idx  # verify runs the BASE weights only
+                and req.output_ids
+                and req.mrope_pos is None  # mrope verify: future work
+            )
+            proposals = (
+                propose_ngram(req.all_token_ids, cfg) if eligible else []
+            )
+            if not proposals:
+                rest.append((slot, req))
+                continue
+            if self.slots[slot] is not req:
+                continue  # a prior iteration's preemption evicted this one
+            chunk = [req.output_ids[-1]] + proposals
+            if not self._ensure_seq_capacity(req, len(chunk)):
+                continue  # preempted
+            if self.slots[slot] is not req:
+                continue
+            arg = self.runner.verify(
+                chunk, prefix_len=req.seq_len,
+                page_table=self.page_tables[slot],
+            )
+            accepted, n_hits = accept_greedy(proposals, [int(a) for a in arg])
+            self.num_spec_drafted += len(proposals)
+            self.num_spec_accepted += n_hits
+            self.num_decode_tokens += len(accepted)
+            self._accept_tokens(req, accepted, [0.0] * len(accepted),
+                                outputs, advance_seq=True)
+        return rest
 
     def _ensure_seq_capacity(self, req: EngineRequest, n_tokens: int = 1) -> bool:
         """Make sure pages exist for positions seq_len..seq_len+n_tokens-1.

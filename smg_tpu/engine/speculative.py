@@ -1,0 +1,73 @@
+"""Speculative decoding: prompt-lookup (n-gram) drafting + batched verify.
+
+Beyond-reference feature (the reference defers decoding strategies to its
+engines): greedy requests draft K tokens by n-gram lookup over their own
+context — the longest recent suffix n-gram that occurred earlier proposes
+the tokens that followed it — and the target model verifies all K in ONE
+prefill-shaped forward (MXU-batch instead of K sequential decode steps).
+
+Correctness: verification accepts exactly the greedy argmax chain, so
+speculative greedy output is token-identical to plain greedy decode (the
+engine's parity tests pin this).  Rejected positions' KV lands beyond
+``seq_len`` and is overwritten later — the same overshoot convention the
+stop-string rollback already relies on (KV past seq_len never enters the
+radix cache).
+
+Sampling (temperature > 0) requests are not speculated in v1 (exact
+rejection-sampling equivalence needs the full draft/target distributions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class SpecConfig:
+    enabled: bool = False
+    max_draft: int = 8       # K: tokens proposed per verify call
+    ngram_max: int = 3       # longest suffix n-gram to match
+    ngram_min: int = 1       # fall back to shorter n-grams down to this
+    #: how far back the lookup scans — bounds the per-token host cost
+    #: (O(window) instead of O(context); repetition useful for drafting is
+    #: overwhelmingly recent)
+    scan_window: int = 1024
+
+
+def propose_ngram(
+    token_ids: "list[int]", cfg: SpecConfig
+) -> "list[int]":
+    """Prompt-lookup draft: longest suffix n-gram (ngram_max down to
+    ngram_min) with an EARLIER occurrence inside the scan window proposes
+    the up-to-max_draft tokens that followed it.  Empty list = nothing to
+    propose."""
+    L = len(token_ids)
+    floor = max(0, L - cfg.scan_window)
+    for n in range(min(cfg.ngram_max, L - 1), cfg.ngram_min - 1, -1):
+        suffix = tuple(token_ids[L - n:])
+        # scan right-to-left for the most recent earlier occurrence
+        for start in range(L - n - 1, floor - 1, -1):
+            if tuple(token_ids[start:start + n]) == suffix:
+                follow = token_ids[start + n:start + n + cfg.max_draft]
+                if follow:
+                    return list(follow)
+    return []
+
+
+def accept_greedy(
+    proposed: "list[int]", argmaxes: "list[int]"
+) -> "tuple[list[int], int]":
+    """Greedy acceptance over the verify forward's per-position argmaxes.
+
+    The verify chunk fed ``[y0, p1, .., pK]``; ``argmaxes[i]`` is the
+    model's choice after chunk[:i+1].  Accept proposals while they match,
+    then append the model's own (always-correct) token at the first
+    mismatch — every call yields >= 1 new token.
+    Returns (accepted_tokens, n_drafts_accepted)."""
+    out: "list[int]" = []
+    i = 0
+    while i < len(proposed) and argmaxes[i] == proposed[i]:
+        out.append(proposed[i])
+        i += 1
+    out.append(int(argmaxes[i]))  # bonus/correction token
+    return out, i

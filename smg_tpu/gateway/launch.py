@@ -65,7 +65,9 @@ def build_engine_from_args(args):
             dtype=getattr(args, "kv_dtype", None) or getattr(args, "dtype", "bfloat16"),
         ),
         scheduler=SchedulerConfig(
-            max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len
+            max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len,
+            speculative=getattr(args, "speculative", False),
+            spec_max_draft=getattr(args, "spec_max_draft", 8),
         ),
         model_id=args.model_path or args.model_preset,
         dtype=getattr(args, "dtype", "bfloat16"),

@@ -211,6 +211,7 @@ def forward_prefill(
     embeds_mask: jnp.ndarray | None = None,  # [T] bool: row comes from input_embeds
     pp_mesh=None,  # Mesh: serving pipeline parallelism over the "pp" axis
     rope_pos: jnp.ndarray | None = None,  # [3, T] M-RoPE position ids
+    all_logits: bool = False,  # static: return [T, V] (speculative verify)
 ):
     """Prefill one sequence chunk; returns (last_token_logits [V], k_cache, v_cache).
 
@@ -313,6 +314,10 @@ def forward_prefill(
             make_body(pos, dest, page_table, ctx_len, inv_freq),
             (h, k_cache, v_cache), xs,
         )
+    if all_logits:
+        # speculative verify: every chunk position's next-token distribution
+        # in one MXU-friendly pass (ops/speculative.py)
+        return unembed(params, cfg, h), k_cache, v_cache
     last = jnp.take_along_axis(
         h, jnp.maximum(t_real - 1, 0)[None, None].astype(jnp.int32), axis=0
     )[0]
