@@ -64,6 +64,8 @@ class EngineRequest:
     # position delta (engine/mrope.py); None = standard rope
     mrope_pos: Any = None
     mrope_delta: int = 0
+    # speculative decoding: consecutive zero-acceptance verifies (back-off)
+    spec_cold: int = 0
 
     @property
     def prompt_len(self) -> int:

@@ -209,6 +209,8 @@ class ModelRunner:
         Crossover measured at ~100k gathered tokens (1B model, v5e)."""
         if self.use_pp:
             return "xla"  # pallas kernels don't run inside the pp shard_map
+        if self.model_cfg.attn_logit_softcap:
+            return "xla"  # kernels lack the Gemma-2 score softcap
         if self.attn_impl != "auto":
             return self.attn_impl
         return "pallas" if B * mp * self.spec.page_size > 131072 else "xla"
@@ -222,6 +224,8 @@ class ModelRunner:
         cheap)."""
         if self.use_pp:
             return "xla"
+        if self.model_cfg.attn_logit_softcap:
+            return "xla"  # kernels lack the Gemma-2 score softcap
         if self.attn_impl == "xla":
             return "xla"
         d = self.model_cfg.head_dim

@@ -516,12 +516,15 @@ class Scheduler:
         """Run spec-eligible slots through draft+verify; returns the slots
         the normal batched decode should still handle.
 
-        Eligible = greedy, unconstrained, penalty-free, no logprobs (the
-        verify pass scores argmaxes only).  Each verify feeds
-        [last_token, drafts...] as one prefill-shaped forward and accepts
-        the longest matching prefix + the model's own next token — >= 1
-        token per call, so speculation never loses to plain decode on
-        steps, only on per-step cost (one bucket-T forward vs one decode)."""
+        Eligible = greedy, unconstrained, penalty-free, no logprobs, no
+        LoRA/M-RoPE (the verify pass scores BASE-model argmaxes only).
+        Each verify feeds [last_token, drafts...] as one prefill-shaped
+        forward and accepts the longest matching prefix + the model's own
+        next token — >= 1 token per call.  Caveats the adaptive back-off
+        (spec_cold) exists for: with decode_horizon > 1 the plain path
+        yields horizon tokens per call, so persistently-missing drafts
+        WOULD lose — three straight zero-acceptance verifies push the
+        request back to the batched path."""
         from smg_tpu.engine.speculative import (
             SpecConfig,
             accept_greedy,
@@ -545,10 +548,16 @@ class Scheduler:
                 and not req.lora_idx  # verify runs the BASE weights only
                 and req.output_ids
                 and req.mrope_pos is None  # mrope verify: future work
+                and req.spec_cold < 3  # acceptance back-off
             )
             proposals = (
                 propose_ngram(req.all_token_ids, cfg) if eligible else []
             )
+            # clip to the sequence bound: verify feeds 1 + len(proposals)
+            # tokens and positions must stay within max_seq_len/page table
+            if proposals:
+                room = min(self.sched.max_seq_len, self.mp * self.ps)
+                proposals = proposals[:max(0, room - req.seq_len - 1)]
             if not proposals:
                 rest.append((slot, req))
                 continue
@@ -559,14 +568,26 @@ class Scheduler:
                 continue  # preempted
             if self.slots[slot] is not req:
                 continue
+            # trim the page table to live pages (same 32x-gather argument as
+            # the batched decode path above)
+            pages_needed = math.ceil(
+                min(req.seq_len + len(chunk), self.sched.max_seq_len) / self.ps
+            )
+            mp_b = 8
+            while mp_b < pages_needed:
+                mp_b *= 2
+            mp_b = min(mp_b, self.mp)
             arg = self.runner.verify(
                 chunk, prefix_len=req.seq_len,
-                page_table=self.page_tables[slot],
+                page_table=self.page_tables[slot][:mp_b],
             )
             accepted, n_hits = accept_greedy(proposals, [int(a) for a in arg])
             self.num_spec_drafted += len(proposals)
             self.num_spec_accepted += n_hits
             self.num_decode_tokens += len(accepted)
+            # adaptive back-off: a context whose n-grams keep missing stops
+            # burning verify calls (cold streak resets on any acceptance)
+            req.spec_cold = 0 if n_hits else req.spec_cold + 1
             self._accept_tokens(req, accepted, [0.0] * len(accepted),
                                 outputs, advance_seq=True)
         return rest

@@ -34,6 +34,18 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
+    # ---- Gemma-2-family knobs (all default to llama semantics) ----
+    activation: str = "silu"  # "silu" | "gelu_tanh"
+    rms_unit_offset: bool = False  # RMSNorm scales by (1 + weight)
+    embed_scale: bool = False  # multiply token embeddings by sqrt(hidden)
+    post_norms: bool = False  # post-attention/post-ffn RMSNorms (4/layer)
+    attn_logit_softcap: float | None = None  # tanh softcap on attn scores
+    final_logit_softcap: float | None = None  # tanh softcap on lm logits
+    query_scale: float | None = None  # 1/sqrt(query_pre_attn_scalar) override
+    # Sliding-window size (engine v1 serves contexts <= window EXACTLY —
+    # global attention equals local attention there; longer contexts are
+    # rejected at config validation rather than silently mis-attended)
+    sliding_window: int | None = None
     # Vision tower (VLM; None = text-only).  ``image_token_id`` is the
     # placeholder the gateway expands per image (Qwen2-VL <|image_pad|>).
     vision: "object | None" = None  # VisionConfig (kept loose: frozen dataclass)
@@ -62,6 +74,23 @@ class ModelConfig:
         eos = cfg.get("eos_token_id", 2)
         eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
         num_heads = cfg["num_attention_heads"]
+        # Gemma-2 family: gelu MLP, (1+w) norms, scaled embeddings, post
+        # norms, attn/final logit softcaps, query_pre_attn_scalar scale
+        gemma = "gemma2" in name or "gemma-2" in name
+        extra: dict = {}
+        if gemma:
+            q_scalar = cfg.get("query_pre_attn_scalar") or cfg.get("head_dim", 256)
+            extra = dict(
+                activation="gelu_tanh",
+                rms_unit_offset=True,
+                embed_scale=True,
+                post_norms=True,
+                attn_logit_softcap=cfg.get("attn_logit_softcapping", 50.0),
+                final_logit_softcap=cfg.get("final_logit_softcapping", 30.0),
+                query_scale=1.0 / (q_scalar ** 0.5),
+                sliding_window=cfg.get("sliding_window"),
+                tie_word_embeddings=cfg.get("tie_word_embeddings", True),
+            )
         vision = None
         vc = cfg.get("vision_config")
         if vc and "vl" in name:
@@ -92,7 +121,9 @@ class ModelConfig:
             rope_scaling=cfg.get("rope_scaling"),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            tie_word_embeddings=extra.pop(
+                "tie_word_embeddings", cfg.get("tie_word_embeddings", False)
+            ),
             eos_token_ids=eos_ids,
             bos_token_id=cfg.get("bos_token_id", 1),
             dtype=dtype,
@@ -101,6 +132,7 @@ class ModelConfig:
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
             vision=vision,
             image_token_id=cfg.get("image_token_id"),
+            **extra,
         )
 
     @classmethod
@@ -185,6 +217,25 @@ def tiny_vlm_config() -> ModelConfig:
     )
 
 
+def tiny_gemma2_config(vocab_size: int = 512) -> ModelConfig:
+    """Tiny Gemma-2-style model for CPU tests: gelu MLP, (1+w) norms,
+    scaled embeddings, post norms, attn/final softcaps, tied unembed."""
+    import dataclasses
+
+    return dataclasses.replace(
+        tiny_test_config(vocab_size),
+        activation="gelu_tanh",
+        rms_unit_offset=True,
+        embed_scale=True,
+        post_norms=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_scale=1.0 / (32.0 ** 0.5),
+        sliding_window=4096,
+        tie_word_embeddings=True,
+    )
+
+
 def tiny_vlm_mrope_config() -> ModelConfig:
     """Tiny VLM with Qwen2-VL M-RoPE enabled (head_dim 16 -> D/2 = 8 =
     2+3+3 frequency sections)."""
@@ -198,6 +249,7 @@ def tiny_vlm_mrope_config() -> ModelConfig:
 
 PRESETS = {
     "tiny": tiny_test_config,
+    "tiny-gemma2": tiny_gemma2_config,
     "tiny-moe": tiny_moe_config,
     "tiny-vlm": tiny_vlm_config,
     "llama3.2-1b": llama32_1b_config,

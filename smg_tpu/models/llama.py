@@ -56,6 +56,15 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "wo": norm_init(ks[4], (L, H, D, E), 0.02 / math.sqrt(2 * L)),
         "mlp_norm": jnp.ones((L, E), dtype),
     }
+    if cfg.rms_unit_offset:
+        # Gemma convention: stored weight is a delta (scale = 1 + w), so
+        # identity init is zeros
+        layers["attn_norm"] = jnp.zeros((L, E), dtype)
+        layers["mlp_norm"] = jnp.zeros((L, E), dtype)
+    if cfg.post_norms:
+        zero = jnp.zeros((L, E), dtype) if cfg.rms_unit_offset else jnp.ones((L, E), dtype)
+        layers["post_attn_norm"] = zero
+        layers["post_mlp_norm"] = zero
     if cfg.num_experts > 0:
         # MoE layers (Qwen-MoE family): router + stacked expert FFNs
         X = cfg.num_experts
@@ -71,7 +80,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     params: Params = {
         "embed": norm_init(ks[0], (V, E), 0.02),
         "layers": layers,
-        "final_norm": jnp.ones((E,), dtype),
+        "final_norm": (jnp.zeros((E,), dtype) if cfg.rms_unit_offset
+                       else jnp.ones((E,), dtype)),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm_init(jax.random.fold_in(key, 99), (E, V), 0.02)
@@ -88,6 +98,9 @@ def logical_axes(cfg: ModelConfig) -> Params:
         "wo": ("layers", "q_heads", "head_dim", "embed"),
         "mlp_norm": ("layers", "embed"),
     }
+    if cfg.post_norms:
+        layers["post_attn_norm"] = ("layers", "embed")
+        layers["post_mlp_norm"] = ("layers", "embed")
     if cfg.num_experts > 0:
         layers["router"] = ("layers", "embed", None)
         layers["w_gate"] = ("layers", "experts", "embed", "ffn")
@@ -114,14 +127,52 @@ def kv_cache_logical_axes() -> tuple[str | None, ...]:
 
 
 def embed_tokens(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    return params["embed"][tokens]
+    h = params["embed"][tokens]
+    if cfg.embed_scale:  # Gemma: embeddings scaled by sqrt(hidden)
+        h = h * jnp.asarray(math.sqrt(cfg.hidden_size), h.dtype)
+    return h
 
 
 def unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = _norm(h, params["final_norm"], cfg)
     if cfg.tie_word_embeddings:
-        return jnp.einsum("...e,ve->...v", h, params["embed"]).astype(jnp.float32)
-    return jnp.einsum("...e,ev->...v", h, params["lm_head"]).astype(jnp.float32)
+        logits = jnp.einsum("...e,ve->...v", h, params["embed"]).astype(jnp.float32)
+    else:
+        logits = jnp.einsum("...e,ev->...v", h, params["lm_head"]).astype(jnp.float32)
+    if cfg.final_logit_softcap:
+        c = cfg.final_logit_softcap
+        logits = c * jnp.tanh(logits / c)
+    return logits
+
+
+
+def _norm(x: jnp.ndarray, weight: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Config-routed RMSNorm (Gemma models scale by 1 + weight)."""
+    return rms_norm(x, weight, cfg.rms_norm_eps, unit_offset=cfg.rms_unit_offset)
+
+
+def _act(x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """MLP gate activation: silu (llama family) or tanh-gelu (Gemma)."""
+    if cfg.activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _attn_residual(h, layer, attn, cfg, lora=None, gates=None):
+    """Residual add of the attention branch, with the Gemma-2 post-attention
+    norm when configured."""
+    o = _attn_out(layer, attn, lora, gates)
+    if cfg.post_norms:
+        o = _norm(o, layer["post_attn_norm"], cfg)
+    return h + o
+
+
+def _mlp_residual(h, layer, cfg):
+    """Pre-norm -> MLP -> (optional Gemma-2 post-ffn norm) -> residual."""
+    o = _mlp(layer, _norm(h, layer["mlp_norm"], cfg), cfg)
+    if cfg.post_norms:
+        o = _norm(o, layer["post_mlp_norm"], cfg)
+    return h + o
 
 
 def _lora_delta(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
@@ -166,7 +217,7 @@ def _mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         return _moe_mlp(layer, h, cfg)
     gate = jnp.einsum("...e,ef->...f", h, layer["w_gate"])
     up = jnp.einsum("...e,ef->...f", h, layer["w_up"])
-    return jnp.einsum("...f,fe->...e", jax.nn.silu(gate) * up, layer["w_down"])
+    return jnp.einsum("...f,fe->...e", _act(gate, cfg) * up, layer["w_down"])
 
 
 def _moe_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -230,7 +281,7 @@ def forward_prefill(
         lora_gates = jnp.broadcast_to(lora_gates, (T, lora_gates.shape[-1]))
     ps = k_cache.shape[2]
     mp = page_table.shape[0]
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
 
     pos = prefix_len + jnp.arange(T)  # [T]
     # padded rows and out-of-range positions write to the garbage page (0);
@@ -256,7 +307,7 @@ def forward_prefill(
                 layer, lor, l = xs
             else:
                 (layer, l), lor = xs, None
-            hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+            hn = _norm(h, layer["attn_norm"], cfg)
             q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)
             if rope_pos is not None:
                 # M-RoPE: 3-axis ids rotate sectioned frequencies; masking
@@ -287,10 +338,10 @@ def forward_prefill(
                 k_ctx, v_ctx = gather_seq_kv(
                     k_cache[l], v_cache[l], page_table, cfg.num_kv_heads
                 )
-                attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale)
-            h = h + _attn_out(layer, attn, lor, lora_gates)
-            hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-            h = h + _mlp(layer, hn, cfg)
+                attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale,
+                                         softcap=cfg.attn_logit_softcap)
+            h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
+            h = _mlp_residual(h, layer, cfg)
             return (h, k_cache, v_cache), None
 
         return layer_body
@@ -343,7 +394,7 @@ def forward_decode(
     B = tokens.shape[0]
     ps = k_cache.shape[2]
     mp = page_tables.shape[1]
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
 
     # out-of-range positions (e.g. decode horizon overshooting a finished
     # sequence) write to the garbage page instead of clobbering a real slot
@@ -363,15 +414,15 @@ def forward_decode(
             layer, lor, l = xs
         else:
             (layer, l), lor = xs, None
-        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        hn = _norm(h, layer["attn_norm"], cfg)
         q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # q: [B, H, D]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
         k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
-        attn = attention_decode(q, k_cache[l], v_cache[l], page_tables, positions, scale)
-        h = h + _attn_out(layer, attn, lor, lora_gates)
-        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn, cfg)
+        attn = attention_decode(q, k_cache[l], v_cache[l], page_tables, positions,
+                                scale, softcap=cfg.attn_logit_softcap)
+        h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
+        h = _mlp_residual(h, layer, cfg)
         return (h, k_cache, v_cache), None
 
     xs = (
@@ -413,7 +464,7 @@ def forward_prefill_batched(
     G_, T = tokens.shape
     ps = k_cache.shape[2]
     mp = page_tables.shape[1]
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
     K, D = cfg.num_kv_heads, cfg.head_dim
 
     pos = prefix_lens[:, None] + jnp.arange(T)[None, :]  # [G, T]
@@ -440,7 +491,7 @@ def forward_prefill_batched(
             layer, lor, l = xs
         else:
             (layer, l), lor = xs, None
-        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        hn = _norm(h, layer["attn_norm"], cfg)
         q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [G, T, H/K, D]
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
@@ -449,17 +500,18 @@ def forward_prefill_batched(
         )
         if no_ctx:
             # cold prompts: the chunk IS the whole context
-            attn = attention_prefill_batched(q, k, v, pos, ctx_lens, scale)
+            attn = attention_prefill_batched(q, k, v, pos, ctx_lens, scale,
+                                             softcap=cfg.attn_logit_softcap)
         else:
             kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
             vl = v_cache[l][page_tables]
             S = mp * ps
             k_ctx = kl.reshape(G_, S, K, D)
             v_ctx = vl.reshape(G_, S, K, D)
-            attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale)
-        h = h + _attn_out(layer, attn, lor, lora_gates)
-        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn, cfg)
+            attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale,
+                                             softcap=cfg.attn_logit_softcap)
+        h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
+        h = _mlp_residual(h, layer, cfg)
         return (h, k_cache, v_cache), None
 
     xs = (
@@ -508,7 +560,7 @@ def forward_decode_horizon(
     Under ``pp_mesh`` the layer stack, the frozen cache, and the side
     buffers shard their layer axis over ``pp`` (``parallel/pp_serving.py``).
     """
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
     K, D = cfg.num_kv_heads, cfg.head_dim
     B = tokens.shape[0]
 
@@ -530,7 +582,7 @@ def forward_decode_horizon(
                 layer, lor, l = xs
             else:
                 (layer, l), lor = xs, None
-            hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+            hn = _norm(h, layer["attn_norm"], cfg)
             q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [B, H/K, D]
             q = apply_rope(q[:, None], rope_positions[:, None], inv_freq)[:, 0]
             k = apply_rope(k[:, None], rope_positions[:, None], inv_freq)[:, 0]
@@ -555,10 +607,10 @@ def forward_decode_horizon(
                 attn = attention_decode_cached(
                     q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
                     page_tables, entry_positions, scale,
+                    softcap=cfg.attn_logit_softcap,
                 )
-            h = h + _attn_out(layer, attn, lor, lora_gates)
-            hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-            h = h + _mlp(layer, hn, cfg)
+            h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
+            h = _mlp_residual(h, layer, cfg)
             return (h, hk_all, hv_all), None
 
         return layer_body
@@ -599,7 +651,7 @@ def forward_embed(
     L2-normalized (serves /v1/embeddings — reference routes embeddings to
     engine ``Embed`` RPCs, ``sglang_scheduler.proto``)."""
     B, T = tokens.shape
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
     pos = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = embed_tokens(params, cfg, tokens)
     # causal mask also masks padding columns beyond each row's length
@@ -607,7 +659,7 @@ def forward_embed(
     causal = jnp.tril(jnp.ones((T, T), bool))[None] & (j[None, None, :] < lengths[:, None, None])
 
     def layer_body(h, layer):
-        hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        hn = _norm(h, layer["attn_norm"], cfg)
         q, k, v = _qkv(layer, cfg, hn)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
@@ -615,17 +667,22 @@ def forward_embed(
         G = cfg.num_heads // K
         qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
         scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
         scores = jnp.where(causal[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
         attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
-        h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
-        hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, hn, cfg)
+        o = jnp.einsum("bthd,hde->bte", attn, layer["wo"])
+        if cfg.post_norms:
+            o = _norm(o, layer["post_attn_norm"], cfg)
+        h = h + o
+        h = _mlp_residual(h, layer, cfg)
         return h, None
 
     h, _ = jax.lax.scan(layer_body, h, params["layers"])
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = _norm(h, params["final_norm"], cfg)
     last = jnp.take_along_axis(
         h, jnp.maximum(lengths - 1, 0)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0].astype(jnp.float32)
@@ -689,9 +746,9 @@ def decoder_layer_train(
     (``smg_tpu/parallel/pipeline.py``), which scans it over a pp stage's
     local layer shard."""
     B, T = h.shape[0], h.shape[1]
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
     pos = jnp.arange(T)[None, :].repeat(B, axis=0)
-    hn = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+    hn = _norm(h, layer["attn_norm"], cfg)
     q, k, v = _qkv(layer, cfg, hn)  # [B, T, H/K, D]
     q = apply_rope(q, pos, inv_freq)
     k = apply_rope(k, pos, inv_freq)
@@ -705,10 +762,15 @@ def decoder_layer_train(
         causal = jnp.tril(jnp.ones((T, T), bool))
         qf = q.astype(jnp.float32).reshape(B, T, K, G, cfg.head_dim)
         scores = jnp.einsum("btkgd,bskd->bkgts", qf, k.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
         scores = jnp.where(causal[None, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
         attn = attn.reshape(B, T, cfg.num_heads, cfg.head_dim).astype(h.dtype)
-    h = h + jnp.einsum("bthd,hde->bte", attn, layer["wo"])
-    hn = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-    return h + _mlp(layer, hn, cfg)
+    o = jnp.einsum("bthd,hde->bte", attn, layer["wo"])
+    if cfg.post_norms:
+        o = _norm(o, layer["post_attn_norm"], cfg)
+    h = h + o
+    return _mlp_residual(h, layer, cfg)

@@ -32,6 +32,12 @@ def _hf_key_map(cfg, n_layers: int) -> dict[str, tuple[str, ...]]:
         ("layers", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
         ("layers", "mlp_norm"): "model.layers.{i}.post_attention_layernorm.weight",
     }
+    if cfg.post_norms:
+        # Gemma-2 four-norm layers: HF's post_attention_layernorm is the
+        # POST-attention norm there, and the ffn pre-norm is its own key
+        m[("layers", "mlp_norm")] = "model.layers.{i}.pre_feedforward_layernorm.weight"
+        m[("layers", "post_attn_norm")] = "model.layers.{i}.post_attention_layernorm.weight"
+        m[("layers", "post_mlp_norm")] = "model.layers.{i}.post_feedforward_layernorm.weight"
     if cfg.num_experts > 0:
         # Qwen-MoE naming: router = mlp.gate.weight, experts under mlp.experts.{e}
         m[("layers", "router")] = "model.layers.{i}.mlp.gate.weight"

@@ -85,6 +85,7 @@ def attention_prefill(
     q_positions: jnp.ndarray,  # [T] global positions of the new tokens
     ctx_len: jnp.ndarray,  # scalar: total valid tokens in k_ctx
     scale: float,
+    softcap: float | None = None,  # tanh softcap on attention logits (Gemma-2)
 ) -> jnp.ndarray:
     """Causal attention for one sequence's prefill chunk. GQA-aware."""
     T, H, D = q.shape
@@ -94,6 +95,8 @@ def attention_prefill(
     kf = k_ctx.astype(jnp.float32)
     vf = v_ctx.astype(jnp.float32)
     scores = jnp.einsum("tkgd,skd->tkgs", qf, kf) * scale  # [T, K, G, S]
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
     j = jnp.arange(S)
     mask = (j[None, :] <= q_positions[:, None]) & (j[None, :] < ctx_len)  # [T, S]
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
@@ -109,6 +112,7 @@ def attention_prefill_batched(
     q_positions: jnp.ndarray,  # [G, T] global positions
     ctx_lens: jnp.ndarray,  # [G] valid tokens per row
     scale: float,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """Batched multi-sequence prefill attention (one row per sequence)."""
     G_, T, H, D = q.shape
@@ -119,6 +123,8 @@ def attention_prefill_batched(
     kf = k_ctx.astype(jnp.float32)
     vf = v_ctx.astype(jnp.float32)
     scores = jnp.einsum("gtkhd,gskd->gtkhs", qf, kf) * scale  # [G, T, K, Gq, S]
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
     j = jnp.arange(S)
     mask = (j[None, None, :] <= q_positions[:, :, None]) & (
         j[None, None, :] < ctx_lens[:, None, None]
@@ -140,6 +146,7 @@ def attention_decode_cached(
     page_tables: jnp.ndarray,  # [B, mp]
     entry_positions: jnp.ndarray,  # [B] cache token count at horizon entry
     scale: float,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """XLA fallback for the horizon-decode attention: cache pages (tokens <
     entry) plus the first n_extra side-buffer rows, one joint softmax.
@@ -165,6 +172,8 @@ def attention_decode_cached(
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qf, k_all, preferred_element_type=jnp.float32
     ) * scale
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
     j = jnp.arange(S + N)
     mask = jnp.where(
         j[None, :] < S,
@@ -187,6 +196,7 @@ def attention_decode(
     page_tables: jnp.ndarray,  # [B, max_pages]
     positions: jnp.ndarray,  # [B] position of the new token (= ctx len - 1)
     scale: float,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """Batched single-token attention over paged KV. GQA-aware.
 
@@ -209,6 +219,8 @@ def attention_decode(
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qf, k, preferred_element_type=jnp.float32
     ) * scale
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
     j = jnp.arange(S)
     mask = j[None, :] <= positions[:, None]  # [B, S]
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)

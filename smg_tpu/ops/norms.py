@@ -7,9 +7,14 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+             unit_offset: bool = False) -> jnp.ndarray:
+    """``unit_offset`` = Gemma convention: scale by (1 + weight)."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     out = xf * (1.0 / jnp.sqrt(var + eps))
-    return (out * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if unit_offset:
+        w = 1.0 + w
+    return (out * w).astype(dtype)

@@ -1,0 +1,172 @@
+"""Gemma-2 family support: gelu MLP, (1+w) RMSNorm, scaled embeddings,
+post-attention/post-ffn norms, attention/final logit softcaps, custom query
+scale.  Reference parity target: the Gemma-2 models the reference routes to
+its engines (SURVEY §0 model families)."""
+
+import numpy as np
+import pytest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import ModelConfig, tiny_gemma2_config, tiny_test_config
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def test_hf_config_parses_gemma2():
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["Gemma2ForCausalLM"],
+        "vocab_size": 256000, "hidden_size": 2304, "intermediate_size": 9216,
+        "num_hidden_layers": 26, "num_attention_heads": 8,
+        "num_key_value_heads": 4, "head_dim": 256,
+        "query_pre_attn_scalar": 256, "sliding_window": 4096,
+        "attn_logit_softcapping": 50.0, "final_logit_softcapping": 30.0,
+        "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+    })
+    assert cfg.activation == "gelu_tanh"
+    assert cfg.rms_unit_offset and cfg.embed_scale and cfg.post_norms
+    assert cfg.attn_logit_softcap == 50.0
+    assert cfg.final_logit_softcap == 30.0
+    assert cfg.query_scale == pytest.approx(1.0 / 16.0)
+    assert cfg.sliding_window == 4096
+    assert cfg.tie_word_embeddings is True
+    # llama configs keep llama semantics
+    base = tiny_test_config()
+    assert base.activation == "silu" and not base.post_norms
+
+
+def test_unit_offset_norm():
+    import jax.numpy as jnp
+
+    from smg_tpu.ops.norms import rms_norm
+
+    x = jnp.asarray([[1.0, 2.0, 3.0]])
+    w = jnp.asarray([0.5, 0.5, 0.5])
+    plain = rms_norm(x, w, 1e-6)
+    offset = rms_norm(x, w, 1e-6, unit_offset=True)
+    np.testing.assert_allclose(np.asarray(offset), np.asarray(plain) * 3.0,
+                               rtol=1e-5)
+    # zero weight + unit offset = identity scale
+    ident = rms_norm(x, jnp.zeros(3), 1e-6, unit_offset=True)
+    norm_only = rms_norm(x, jnp.ones(3), 1e-6)
+    np.testing.assert_allclose(np.asarray(ident), np.asarray(norm_only),
+                               rtol=1e-6)
+
+
+def test_attention_softcap_bounds_scores():
+    import jax
+    import jax.numpy as jnp
+
+    from smg_tpu.ops.attention import attention_prefill
+
+    T, K, G, D = 4, 2, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (T, K * G, D)) * 100
+    k = jax.random.normal(jax.random.PRNGKey(1), (T, K, D)) * 100
+    v = jax.random.normal(jax.random.PRNGKey(2), (T, K, D))
+    pos = jnp.arange(T)
+    out_plain = attention_prefill(q, k, v, pos, jnp.int32(T), 1.0)
+    out_cap = attention_prefill(q, k, v, pos, jnp.int32(T), 1.0, softcap=5.0)
+    # with huge logits the uncapped softmax saturates to one-hot; the capped
+    # one cannot — outputs must differ
+    assert not np.allclose(np.asarray(out_plain), np.asarray(out_cap), atol=1e-3)
+    # softcap=None is exactly the plain path
+    out_none = attention_prefill(q, k, v, pos, jnp.int32(T), 1.0, softcap=None)
+    np.testing.assert_array_equal(np.asarray(out_plain), np.asarray(out_none))
+
+
+def _gemma_engine() -> Engine:
+    return Engine(EngineConfig(
+        model=tiny_gemma2_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=256, max_prefill_tokens=32,
+            prefill_token_buckets=(16, 32), decode_batch_buckets=(2, 4),
+        ),
+        dtype="float32", model_id="tiny-gemma2",
+    ), tokenizer=MockTokenizer())
+
+
+def test_gemma2_generates_and_differs_from_llama():
+    """Tiny Gemma-2 engine: deterministic generation; the family knobs
+    measurably change the computation vs a same-seed llama config."""
+    import threading
+
+    def gen(eng, prompt, n=8):
+        done = threading.Event()
+        acc = []
+
+        def cb(out):
+            acc.extend(out.new_token_ids)
+            if out.finished:
+                done.set()
+
+        eng.submit(prompt, SamplingParams(temperature=0.0, max_new_tokens=n,
+                                          ignore_eos=True), on_output=cb)
+        for _ in range(300):
+            eng.step()
+            if done.is_set():
+                return list(acc)
+        raise TimeoutError
+
+    g = _gemma_engine()
+    try:
+        prompt = list(range(5, 25))
+        a = gen(g, prompt)
+        b = gen(g, prompt)
+        assert a == b and len(a) == 8
+        # chunked prefill path too
+        long_prompt = [(i * 3) % 90 + 7 for i in range(50)]
+        c = gen(g, long_prompt)
+        assert len(c) == 8
+        # post-norm params exist and loaded shapes match
+        assert "post_attn_norm" in g.runner.params["layers"]
+        assert "post_mlp_norm" in g.runner.params["layers"]
+        # gemma forces the XLA attention paths (kernels lack softcap)
+        assert g.runner._prefill_impl_for(8) == "xla"
+        assert g.runner._attn_impl_for(64, 512) == "xla"
+    finally:
+        g.stop()
+
+
+def test_final_softcap_bounds_logits():
+    import jax
+    import jax.numpy as jnp
+
+    from smg_tpu.models import llama
+
+    cfg = tiny_gemma2_config()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    h = jax.random.normal(jax.random.PRNGKey(1), (3, cfg.hidden_size)) * 50
+    logits = llama.unembed(params, cfg, h)
+    assert float(jnp.max(jnp.abs(logits))) <= cfg.final_logit_softcap + 1e-3
+
+
+def test_sliding_window_validation():
+    from smg_tpu.config import validate_engine_config
+
+    cfg = EngineConfig(
+        model=tiny_gemma2_config(),
+        cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=2, max_seq_len=8192, max_prefill_tokens=32,
+            prefill_token_buckets=(32,), decode_batch_buckets=(2,),
+        ),
+        dtype="float32",
+    )
+    issues = validate_engine_config(cfg)
+    assert any("sliding window" in i.message for i in issues)
+
+
+def test_gemma_weight_mapping_keys():
+    from smg_tpu.models.weights import _hf_key_map
+
+    m = _hf_key_map(tiny_gemma2_config(), 4)
+    assert m[("layers", "mlp_norm")].endswith("pre_feedforward_layernorm.weight")
+    assert m[("layers", "post_attn_norm")].endswith("post_attention_layernorm.weight")
+    assert m[("layers", "post_mlp_norm")].endswith("post_feedforward_layernorm.weight")
+    # llama mapping unchanged
+    lm = _hf_key_map(tiny_test_config(), 4)
+    assert lm[("layers", "mlp_norm")].endswith("post_attention_layernorm.weight")
+    assert ("layers", "post_attn_norm") not in lm
