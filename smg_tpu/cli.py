@@ -52,6 +52,13 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
                         "http://127.0.0.1:4318); enables trace export")
     g.add_argument("--otel-service-name", default="smg-tpu",
                    dest="otel_service_name")
+    g.add_argument("--mm-transport", default="auto", dest="mm_transport",
+                   choices=["inline", "shm", "auto"],
+                   help="pixel transport to encode workers: inline bytes, "
+                        "same-host shared memory, or auto (shm for loopback "
+                        "workers above the size threshold)")
+    g.add_argument("--mm-shm-min-bytes", type=int, default=1 << 20,
+                   dest="mm_shm_min_bytes")
     g.add_argument("--kv-connector", default="auto", choices=["auto", "host", "device"],
                    help="PD KV handoff: device-to-device jax transfer or host bytes")
     g.add_argument("--provider-config", default=None,

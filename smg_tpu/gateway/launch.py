@@ -140,6 +140,15 @@ async def _run_gateway(args) -> int:
     )
     if getattr(args, "provider_config", None):
         ctx.providers.load_config(args.provider_config)
+    if getattr(args, "mm_transport", None):
+        # process-wide transport policy for every gRPC worker client
+        # (reference: --multimodal-* flags, main.rs:319-328)
+        from smg_tpu.rpc.client import GrpcWorkerClient
+
+        GrpcWorkerClient.mm_transport = args.mm_transport
+        GrpcWorkerClient.mm_shm_min_bytes = getattr(
+            args, "mm_shm_min_bytes", 1 << 20
+        )
     if getattr(args, "plugins", None):
         ctx.load_plugins(args.plugins,
                          fail_open=not getattr(args, "plugin_fail_closed", False))

@@ -262,19 +262,65 @@ class GrpcWorkerClient(WorkerClient):
             raise RuntimeError(f"worker embed error: {resp.error}")
         return [list(v.values) for v in resp.embeddings]
 
+    #: mm pixel transport: "inline" | "shm" | "auto" (reference ladder,
+    #: main.rs:319-328).  auto = shm for loopback workers above the
+    #: threshold; payloads below ride inline either way.
+    mm_transport = "auto"
+    mm_shm_min_bytes = 1 << 20
+
+    def _same_host(self) -> bool:
+        host = self.url.rsplit(":", 1)[0]
+        return host in ("127.0.0.1", "localhost", "::1", "[::1]")
+
     async def encode_image(self, pixel_values, grid: tuple) -> "object":
         import numpy as np
 
         pixels = np.ascontiguousarray(np.asarray(pixel_values, np.float32))
-        resp = await self._encode(
-            pb.EncodeRequestProto(
-                rid="encode",
-                pixel_values=pixels.tobytes(),
-                n_patches=pixels.shape[0], patch_dim=pixels.shape[1],
-                grid_h=int(grid[0]), grid_w=int(grid[1]),
-            ),
-            timeout=300,
+        use_shm = (
+            self.mm_transport == "shm"
+            or (self.mm_transport == "auto"
+                and pixels.nbytes >= self.mm_shm_min_bytes
+                and self._same_host())
         )
+        shm = None
+        msg = pb.EncodeRequestProto(
+            rid="encode",
+            n_patches=pixels.shape[0], patch_dim=pixels.shape[1],
+            grid_h=int(grid[0]), grid_w=int(grid[1]),
+        )
+        if use_shm:
+            from multiprocessing import shared_memory
+
+            try:
+                shm = shared_memory.SharedMemory(create=True, size=pixels.nbytes)
+                # zero-extra-copy write: view the segment as the target
+                # array instead of materializing tobytes() first
+                np.ndarray(pixels.shape, np.float32, buffer=shm.buf)[:] = pixels
+                msg.shm_name = shm.name
+            except OSError:
+                shm = None  # /dev/shm unavailable: fall back to inline
+        if shm is None:
+            msg.pixel_values = pixels.tobytes()
+        try:
+            resp = await self._encode(msg, timeout=300)
+            if (shm is not None and resp.error
+                    and resp.error.startswith("shm_unavailable")):
+                # loopback address but no shared /dev/shm (worker in a
+                # container): transparent inline retry, once
+                logger.warning(
+                    "worker %s cannot open shm segments; retrying inline "
+                    "(set --mm-transport inline to skip the probe)", self.url,
+                )
+                msg.shm_name = ""
+                msg.pixel_values = pixels.tobytes()
+                resp = await self._encode(msg, timeout=300)
+        finally:
+            if shm is not None:
+                shm.close()
+                try:
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
         if resp.error:
             raise RuntimeError(f"worker encode error: {resp.error}")
         return np.frombuffer(resp.embeds, dtype=np.float32).reshape(

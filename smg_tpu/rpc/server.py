@@ -135,14 +135,43 @@ class SchedulerServicer:
 
     async def Encode(self, request: pb.EncodeRequestProto, context):
         """EPD encode leg: vision-tower forward on pre-patchified pixels
-        (reference: the tokenspeed encoder servicer's Encode RPC)."""
+        (reference: the tokenspeed encoder servicer's Encode RPC).  Pixels
+        arrive inline, or via a same-host shared-memory segment (the
+        inline/shm transport ladder, main.rs:319-328)."""
         import numpy as np
 
         loop = asyncio.get_running_loop()
         try:
-            pixels = np.frombuffer(
-                request.pixel_values, dtype=np.float32
-            ).reshape(request.n_patches, request.patch_dim)
+            if request.shm_name:
+                from multiprocessing import resource_tracker, shared_memory
+
+                try:
+                    shm = shared_memory.SharedMemory(name=request.shm_name)
+                except (FileNotFoundError, OSError) as e:
+                    # distinguishable error: the client retries inline (a
+                    # loopback address doesn't guarantee a shared /dev/shm —
+                    # containers, separate mount namespaces)
+                    return pb.EncodeResponseProto(
+                        error=f"shm_unavailable: {e}"
+                    )
+                try:
+                    # we ATTACHED (didn't create): unregister from this
+                    # process's resource tracker or shutdown spews leaked-
+                    # segment warnings and double-unlinks (creator unlinks)
+                    try:
+                        resource_tracker.unregister(shm._name, "shared_memory")
+                    except Exception:
+                        pass
+                    pixels = np.frombuffer(
+                        shm.buf[: request.n_patches * request.patch_dim * 4],
+                        dtype=np.float32,
+                    ).reshape(request.n_patches, request.patch_dim).copy()
+                finally:
+                    shm.close()  # creator (the gateway) unlinks
+            else:
+                pixels = np.frombuffer(
+                    request.pixel_values, dtype=np.float32
+                ).reshape(request.n_patches, request.patch_dim)
             grid = (request.grid_h, request.grid_w)
             out = await loop.run_in_executor(
                 None, lambda: self.engine.encode_image(pixels, grid)

@@ -78,6 +78,13 @@ def make_train_step(
     if use_ring_attention is None:
         # default on when the mesh actually shards the sequence
         use_ring_attention = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+    if use_ring_attention and cfg.attn_logit_softcap:
+        # ring attention has no tanh softcap — training a Gemma-2 config
+        # through it would silently diverge from the serving forward
+        raise ValueError(
+            "ring attention does not implement attn_logit_softcap; train "
+            "softcapped (Gemma-2) models with sp=1 / use_ring_attention=False"
+        )
     ring_mesh = mesh if use_ring_attention else None
 
     pp_mesh = mesh if pp > 1 else None

@@ -478,3 +478,45 @@ def test_engine_uses_loaded_vision_params(tmp_path):
             eng.stop()
     finally:
         base.stop()
+
+
+def test_encode_shm_transport_parity(vlm_stack):
+    """Same-host shm pixel transport (reference mm transport ladder,
+    main.rs:319-328): forced-shm encode matches inline bit-for-bit and the
+    segment is unlinked afterwards."""
+    h = vlm_stack
+    import glob
+
+    from smg_tpu.rpc.client import GrpcWorkerClient
+
+    vcfg = h.engine.config.model.vision
+    rng = np.random.default_rng(13)
+    gh, gw = 4, 8
+    pixels = rng.standard_normal((gh * gw, vcfg.patch_dim)).astype(np.float32)
+
+    client = next(
+        w.client for w in h.ctx.registry.list()
+        if isinstance(w.client, GrpcWorkerClient)
+    )
+    before = set(glob.glob("/dev/shm/*"))
+
+    async def go(mode, min_bytes=0):
+        old_t, old_m = client.mm_transport, client.mm_shm_min_bytes
+        client.mm_transport, client.mm_shm_min_bytes = mode, min_bytes
+        try:
+            return await client.encode_image(pixels, (gh, gw))
+        finally:
+            client.mm_transport, client.mm_shm_min_bytes = old_t, old_m
+
+    inline = h.run(go("inline"))
+    shm = h.run(go("shm"))
+    np.testing.assert_array_equal(inline, shm)
+    # auto below threshold -> inline path still works
+    auto_small = h.run(go("auto", min_bytes=1 << 30))
+    np.testing.assert_array_equal(inline, auto_small)
+    # auto above threshold on loopback -> shm path
+    auto_big = h.run(go("auto", min_bytes=1))
+    np.testing.assert_array_equal(inline, auto_big)
+    # no leaked segments
+    after = set(glob.glob("/dev/shm/*"))
+    assert after <= before | set()
