@@ -97,7 +97,7 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="compute/weight dtype (bfloat16 on TPU; float32 for "
                         "CPU smoke runs)")
     g.add_argument("--kv-dtype", default=None, dest="kv_dtype",
-                   choices=["bfloat16", "float32", "float16", "int8"],
+                   choices=["bfloat16", "float32", "float16"],
                    help="KV cache dtype (default: follow --dtype)")
     g.add_argument("--speculative", action="store_true",
                    help="prompt-lookup speculative decoding for greedy "

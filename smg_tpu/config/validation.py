@@ -75,22 +75,19 @@ def validate_engine_config(cfg) -> list[ValidationIssue]:
                     f"tp={par.tp} does not divide intermediate_size="
                     f"{model.intermediate_size}",
                 ))
-        if (model.sliding_window
-                and sched is not None
-                and sched.max_seq_len > model.sliding_window):
-            issues.append(_err(
-                "scheduler.max_seq_len",
-                f"max_seq_len={sched.max_seq_len} exceeds the model's "
-                f"sliding window {model.sliding_window}: v1 serves "
-                "window-local models exactly only within the window "
-                "(global == local attention there); raise the window or "
-                "lower max_seq_len",
-            ))
-        if model.attn_logit_softcap and par.sp > 1:
+        if (model.attn_logit_softcap or model.sliding_window) and par.sp > 1:
             issues.append(_err(
                 "parallel.sp",
-                "ring attention does not implement the Gemma-2 attention "
-                "softcap; sp must be 1 for softcapped models",
+                "ring attention implements neither the Gemma-2 attention "
+                "softcap nor sliding windows; sp must be 1 for such models",
+            ))
+        if model.sliding_window and model.sliding_window_pattern > 0 and par.pp > 1:
+            issues.append(_err(
+                "parallel.pp",
+                "pipeline stages scan LOCAL layer indices, which would "
+                "invert the global/sliding alternation on later stages; "
+                "pp must be 1 for window-alternating models (every-layer "
+                "windows, pattern=0, are pp-safe)",
             ))
         if par.pp > 1 and model.num_layers % par.pp != 0:
             issues.append(_err(

@@ -209,8 +209,8 @@ class ModelRunner:
         Crossover measured at ~100k gathered tokens (1B model, v5e)."""
         if self.use_pp:
             return "xla"  # pallas kernels don't run inside the pp shard_map
-        if self.model_cfg.attn_logit_softcap:
-            return "xla"  # kernels lack the Gemma-2 score softcap
+        if self.model_cfg.attn_logit_softcap or self.model_cfg.sliding_window:
+            return "xla"  # kernels lack softcap/sliding-window masks
         if self.attn_impl != "auto":
             return self.attn_impl
         return "pallas" if B * mp * self.spec.page_size > 131072 else "xla"
@@ -224,8 +224,8 @@ class ModelRunner:
         cheap)."""
         if self.use_pp:
             return "xla"
-        if self.model_cfg.attn_logit_softcap:
-            return "xla"  # kernels lack the Gemma-2 score softcap
+        if self.model_cfg.attn_logit_softcap or self.model_cfg.sliding_window:
+            return "xla"  # kernels lack softcap/sliding-window masks
         if self.attn_impl == "xla":
             return "xla"
         d = self.model_cfg.head_dim
@@ -1053,6 +1053,11 @@ class ModelRunner:
         while B < n:
             B *= 2
         cap = max(self.config.scheduler.prefill_token_buckets)
+        if self.model_cfg.sliding_window:
+            # forward_embed's shared layer body has no per-layer window
+            # alternation: bound REAL lengths (not the padded bucket) to
+            # the window, where global == local exactly
+            cap = min(cap, self.model_cfg.sliding_window)
         # embeddings truncate at the context budget (OpenAI-style) rather than fail
         batches = [b[:cap] for b in batches]
         t_max = max(len(b) for b in batches)

@@ -42,10 +42,13 @@ class ModelConfig:
     attn_logit_softcap: float | None = None  # tanh softcap on attn scores
     final_logit_softcap: float | None = None  # tanh softcap on lm logits
     query_scale: float | None = None  # 1/sqrt(query_pre_attn_scalar) override
-    # Sliding-window size (engine v1 serves contexts <= window EXACTLY —
-    # global attention equals local attention there; longer contexts are
-    # rejected at config validation rather than silently mis-attended)
+    # Sliding-window attention (Gemma-2 / Mistral): window size and the
+    # alternation pattern — every ``sliding_window_pattern``-th layer is
+    # GLOBAL, the rest attend locally.  Serving applies real per-layer
+    # window masks; train/embed support contexts <= window (trace-time
+    # check) since their shared layer body has no per-layer index.
     sliding_window: int | None = None
+    sliding_window_pattern: int = 2
     # Vision tower (VLM; None = text-only).  ``image_token_id`` is the
     # placeholder the gateway expands per image (Qwen2-VL <|image_pad|>).
     vision: "object | None" = None  # VisionConfig (kept loose: frozen dataclass)
@@ -78,6 +81,12 @@ class ModelConfig:
         # norms, attn/final logit softcaps, query_pre_attn_scalar scale
         gemma = "gemma2" in name or "gemma-2" in name
         extra: dict = {}
+        if "mistral" in name and cfg.get("sliding_window"):
+            # Mistral v0.1-style: EVERY layer windowed (pattern 0)
+            extra = dict(
+                sliding_window=cfg["sliding_window"],
+                sliding_window_pattern=0,
+            )
         if gemma:
             q_scalar = cfg.get("query_pre_attn_scalar") or cfg.get("head_dim", 256)
             extra = dict(

@@ -175,6 +175,22 @@ def _mlp_residual(h, layer, cfg):
     return h + o
 
 
+
+def _layer_window(cfg: ModelConfig, l) -> "jnp.ndarray | None":
+    """Per-layer sliding window: every ``sliding_window_pattern``-th layer
+    is GLOBAL (window 0), the rest use ``cfg.sliding_window`` (Gemma-2
+    alternation); ``pattern <= 0`` = EVERY layer windowed (Mistral).
+    ``l`` is the traced layer index from the scan; None when the model has
+    no window at all.  NOTE ``l`` is stage-LOCAL under pp, so validation
+    rejects pp>1 for alternating patterns."""
+    if not cfg.sliding_window:
+        return None
+    p = cfg.sliding_window_pattern
+    if p <= 0:
+        return jnp.int32(cfg.sliding_window)
+    return jnp.where((l % p) == (p - 1), 0, cfg.sliding_window)
+
+
 def _lora_delta(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
                 gates: jnp.ndarray) -> jnp.ndarray:
     """Per-token multi-adapter LoRA delta, dense one-hot dispatch.
@@ -339,7 +355,8 @@ def forward_prefill(
                     k_cache[l], v_cache[l], page_table, cfg.num_kv_heads
                 )
                 attn = attention_prefill(q, k_ctx, v_ctx, pos, ctx_len, scale,
-                                         softcap=cfg.attn_logit_softcap)
+                                         softcap=cfg.attn_logit_softcap,
+                                         window=_layer_window(cfg, l))
             h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
             h = _mlp_residual(h, layer, cfg)
             return (h, k_cache, v_cache), None
@@ -420,7 +437,8 @@ def forward_decode(
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
         k_cache, v_cache = scatter_kv_pages_full(k_cache, v_cache, l, k, v, dest)
         attn = attention_decode(q, k_cache[l], v_cache[l], page_tables, positions,
-                                scale, softcap=cfg.attn_logit_softcap)
+                                scale, softcap=cfg.attn_logit_softcap,
+                                window=_layer_window(cfg, l))
         h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
         h = _mlp_residual(h, layer, cfg)
         return (h, k_cache, v_cache), None
@@ -501,7 +519,8 @@ def forward_prefill_batched(
         if no_ctx:
             # cold prompts: the chunk IS the whole context
             attn = attention_prefill_batched(q, k, v, pos, ctx_lens, scale,
-                                             softcap=cfg.attn_logit_softcap)
+                                             softcap=cfg.attn_logit_softcap,
+                                             window=_layer_window(cfg, l))
         else:
             kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
             vl = v_cache[l][page_tables]
@@ -509,7 +528,8 @@ def forward_prefill_batched(
             k_ctx = kl.reshape(G_, S, K, D)
             v_ctx = vl.reshape(G_, S, K, D)
             attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale,
-                                             softcap=cfg.attn_logit_softcap)
+                                             softcap=cfg.attn_logit_softcap,
+                                             window=_layer_window(cfg, l))
         h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
         h = _mlp_residual(h, layer, cfg)
         return (h, k_cache, v_cache), None
@@ -608,6 +628,7 @@ def forward_decode_horizon(
                     q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
                     page_tables, entry_positions, scale,
                     softcap=cfg.attn_logit_softcap,
+                    window=_layer_window(cfg, l),
                 )
             h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
             h = _mlp_residual(h, layer, cfg)
@@ -651,6 +672,8 @@ def forward_embed(
     L2-normalized (serves /v1/embeddings — reference routes embeddings to
     engine ``Embed`` RPCs, ``sglang_scheduler.proto``)."""
     B, T = tokens.shape
+    # window bound on REAL lengths is enforced host-side in runner.embed —
+    # T here is the padded bucket and padding columns are masked anyway
     scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
     pos = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = embed_tokens(params, cfg, tokens)
@@ -746,6 +769,13 @@ def decoder_layer_train(
     (``smg_tpu/parallel/pipeline.py``), which scans it over a pp stage's
     local layer shard."""
     B, T = h.shape[0], h.shape[1]
+    if cfg.sliding_window and T > cfg.sliding_window:
+        # training T is the REAL (unpadded) sequence length, so this bound
+        # is exact; decoder_layer_train has no per-layer window alternation
+        raise ValueError(
+            f"training supports contexts <= sliding_window "
+            f"({cfg.sliding_window}); got {T}"
+        )
     scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
     pos = jnp.arange(T)[None, :].repeat(B, axis=0)
     hn = _norm(h, layer["attn_norm"], cfg)

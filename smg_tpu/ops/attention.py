@@ -86,6 +86,7 @@ def attention_prefill(
     ctx_len: jnp.ndarray,  # scalar: total valid tokens in k_ctx
     scale: float,
     softcap: float | None = None,  # tanh softcap on attention logits (Gemma-2)
+    window: jnp.ndarray | None = None,  # scalar sliding window (<=0 = global)
 ) -> jnp.ndarray:
     """Causal attention for one sequence's prefill chunk. GQA-aware."""
     T, H, D = q.shape
@@ -99,6 +100,10 @@ def attention_prefill(
         scores = softcap * jnp.tanh(scores / softcap)
     j = jnp.arange(S)
     mask = (j[None, :] <= q_positions[:, None]) & (j[None, :] < ctx_len)  # [T, S]
+    if window is not None:
+        mask = mask & (
+            (window <= 0) | (j[None, :] > q_positions[:, None] - window)
+        )
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tkgs,skd->tkgd", probs, vf)
@@ -113,6 +118,7 @@ def attention_prefill_batched(
     ctx_lens: jnp.ndarray,  # [G] valid tokens per row
     scale: float,
     softcap: float | None = None,
+    window: jnp.ndarray | None = None,  # scalar sliding window (<=0 = global)
 ) -> jnp.ndarray:
     """Batched multi-sequence prefill attention (one row per sequence)."""
     G_, T, H, D = q.shape
@@ -129,6 +135,10 @@ def attention_prefill_batched(
     mask = (j[None, None, :] <= q_positions[:, :, None]) & (
         j[None, None, :] < ctx_lens[:, None, None]
     )  # [G, T, S]
+    if window is not None:
+        mask = mask & (
+            (window <= 0) | (j[None, None, :] > q_positions[:, :, None] - window)
+        )
     scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("gtkhs,gskd->gtkhd", probs, vf)
@@ -147,6 +157,7 @@ def attention_decode_cached(
     entry_positions: jnp.ndarray,  # [B] cache token count at horizon entry
     scale: float,
     softcap: float | None = None,
+    window: jnp.ndarray | None = None,  # scalar sliding window (<=0 = global)
 ) -> jnp.ndarray:
     """XLA fallback for the horizon-decode attention: cache pages (tokens <
     entry) plus the first n_extra side-buffer rows, one joint softmax.
@@ -180,6 +191,14 @@ def attention_decode_cached(
         j[None, :] < entry_positions[:, None],
         (j[None, :] - S) < n_extra,
     )
+    if window is not None:
+        # absolute key positions: cache slot index below S, side-buffer row
+        # entry+(j-S) above; the query sits at entry + n_extra - 1
+        key_pos = jnp.where(
+            j[None, :] < S, j[None, :], entry_positions[:, None] + (j[None, :] - S)
+        )
+        q_pos = entry_positions[:, None] + n_extra - 1
+        mask = mask & ((window <= 0) | (key_pos > q_pos - window))
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
@@ -197,6 +216,7 @@ def attention_decode(
     positions: jnp.ndarray,  # [B] position of the new token (= ctx len - 1)
     scale: float,
     softcap: float | None = None,
+    window: jnp.ndarray | None = None,  # scalar sliding window (<=0 = global)
 ) -> jnp.ndarray:
     """Batched single-token attention over paged KV. GQA-aware.
 
@@ -223,6 +243,8 @@ def attention_decode(
         scores = softcap * jnp.tanh(scores / softcap)
     j = jnp.arange(S)
     mask = j[None, :] <= positions[:, None]  # [B, S]
+    if window is not None:
+        mask = mask & ((window <= 0) | (j[None, :] > positions[:, None] - window))
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
