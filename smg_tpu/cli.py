@@ -68,6 +68,11 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
                    help="tokenizer for gateway-side text processing (launch mode)")
     g.add_argument("--mesh-port", type=int, default=None,
                    help="enable HA mesh gossip on this port")
+    g.add_argument("--mesh-tls-cert", default=None, dest="mesh_tls_cert",
+                   help="node certificate for mesh mTLS")
+    g.add_argument("--mesh-tls-key", default=None, dest="mesh_tls_key")
+    g.add_argument("--mesh-tls-ca", default=None, dest="mesh_tls_ca",
+                   help="CA bundle peers must be signed by")
     g.add_argument("--mesh-seed", action="append", default=[], dest="mesh_seeds",
                    help="mesh seed peer host:port (repeatable)")
     g.add_argument("--plugins", action="append", default=[],

@@ -226,7 +226,10 @@ async def _run_gateway(args) -> int:
 
         mesh_node = GossipNode(
             GossipConfig(host="0.0.0.0", port=args.mesh_port,
-                         seeds=list(getattr(args, "mesh_seeds", [])))
+                         seeds=list(getattr(args, "mesh_seeds", [])),
+                         tls_cert_file=getattr(args, "mesh_tls_cert", None),
+                         tls_key_file=getattr(args, "mesh_tls_key", None),
+                         tls_ca_file=getattr(args, "mesh_tls_ca", None))
         )
         await mesh_node.start()
         WorkerSyncAdapter(ctx.registry, mesh_node.state)

@@ -30,6 +30,28 @@ class GossipConfig:
     seeds: list[str] = field(default_factory=list)  # "host:port"
     interval_secs: float = 1.0
     suspect_after: int = 3  # missed rounds before marking a peer dead
+    # mutual TLS (reference: crates/mesh transport security): all three
+    # paths set = every gossip connection is mTLS — the server REQUIRES a
+    # client cert signed by ca_file, and dials verify the peer against it.
+    # Unset = plaintext (single-trust-domain deployments).
+    tls_cert_file: str | None = None
+    tls_key_file: str | None = None
+    tls_ca_file: str | None = None
+
+    def __post_init__(self) -> None:
+        tls = (self.tls_cert_file, self.tls_key_file, self.tls_ca_file)
+        if any(tls) and not all(tls):
+            # partial TLS config must FAIL, not silently run plaintext —
+            # that's a security downgrade the operator would never see
+            raise ValueError(
+                "mesh mTLS needs all of tls_cert_file/tls_key_file/"
+                f"tls_ca_file; got cert={bool(tls[0])} key={bool(tls[1])} "
+                f"ca={bool(tls[2])}"
+            )
+
+    @property
+    def tls_enabled(self) -> bool:
+        return bool(self.tls_cert_file and self.tls_key_file and self.tls_ca_file)
 
 
 @dataclass
@@ -60,9 +82,41 @@ class GossipNode:
 
     # ---- lifecycle ----
 
+    def _ssl_server(self):
+        """Server SSL context, built once (contexts are shareable; per-dial
+        rebuilds would re-read cert files on the event loop every round)."""
+        if not self.config.tls_enabled:
+            return None
+        if getattr(self, "_server_ctx", None) is None:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.config.tls_cert_file, self.config.tls_key_file)
+            ctx.load_verify_locations(self.config.tls_ca_file)
+            ctx.verify_mode = ssl.CERT_REQUIRED  # mutual: peers present certs
+            self._server_ctx = ctx
+        return self._server_ctx
+
+    def _ssl_client(self):
+        if not self.config.tls_enabled:
+            return None
+        if getattr(self, "_client_ctx", None) is None:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.load_cert_chain(self.config.tls_cert_file, self.config.tls_key_file)
+            ctx.load_verify_locations(self.config.tls_ca_file)
+            # mesh peers are addressed by ip:port, not certificate
+            # hostnames; trust is the shared CA, not the name
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            self._client_ctx = ctx
+        return self._client_ctx
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.config.host, self.config.port
+            self._handle_conn, self.config.host, self.config.port,
+            ssl=self._ssl_server(),
         )
         port = self._server.sockets[0].getsockname()[1]
         self.addr = f"{self.config.host}:{port}"
@@ -173,7 +227,8 @@ class GossipNode:
         host, port = peer.addr.rsplit(":", 1)
         try:
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, int(port)), timeout=2.0
+                asyncio.open_connection(host, int(port), ssl=self._ssl_client()),
+                timeout=2.0,
             )
             await _write_frame(writer, self._payload())
             resp = await asyncio.wait_for(_read_frame(reader), timeout=2.0)

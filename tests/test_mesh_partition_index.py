@@ -4,6 +4,7 @@ the PositionalIndexer jump-search (VERDICT r3 weak #10)."""
 import time
 
 import numpy as np
+import pytest
 
 from smg_tpu.kv_index.positional import PositionalIndexer, chain_hash
 from smg_tpu.mesh import GossipConfig, GossipNode, PartitionConfig, PartitionState
@@ -106,3 +107,107 @@ def test_partition_small_cluster_never_partitions():
                                 last_seen=time.monotonic() - 999)}
     # 2-node cluster below min_cluster_size: always NORMAL
     assert node.partition.detect(node) is PartitionState.NORMAL
+
+
+def _make_certs(tmp_path, ca_name="mesh-ca"):
+    """Self-signed CA + a node cert signed by it (openssl CLI)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl CLI unavailable")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=tmp_path)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "ca.key", "-out", "ca.crt", "-days", "1",
+        "-subj", f"/CN={ca_name}")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "node.key", "-out", "node.csr", "-subj", "/CN=mesh-node")
+    run("openssl", "x509", "-req", "-in", "node.csr", "-CA", "ca.crt",
+        "-CAkey", "ca.key", "-CAcreateserial", "-out", "node.crt",
+        "-days", "1")
+    return (str(tmp_path / "node.crt"), str(tmp_path / "node.key"),
+            str(tmp_path / "ca.crt"))
+
+
+def test_mesh_mtls_gossip(tmp_path):
+    """Two nodes gossip over mutual TLS; a plaintext dial and a
+    foreign-CA client are both rejected (reference: mesh transport
+    security)."""
+    import asyncio
+
+    d = tmp_path / "a"
+    d.mkdir()
+    cert, key, ca = _make_certs(d)
+
+    async def go():
+        cfg = dict(tls_cert_file=cert, tls_key_file=key, tls_ca_file=ca,
+                   interval_secs=0.1)
+        a = GossipNode(GossipConfig(node_id="a", **cfg))
+        await a.start()
+        b = GossipNode(GossipConfig(node_id="b", seeds=[a.addr], **cfg))
+        await b.start()
+        try:
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if (any(m.node_id == "b" for m in a.alive_members())
+                        and any(m.node_id == "a" for m in b.alive_members())):
+                    break
+            else:
+                raise AssertionError("mTLS gossip never converged")
+
+            # plaintext client: TLS handshake fails
+            host, port = a.addr.rsplit(":", 1)
+            try:
+                r, w = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)), 2.0)
+                w.write(b'{"x":1}\n')
+                await w.drain()
+                data = await asyncio.wait_for(r.read(100), 2.0)
+                assert data == b""  # server drops the non-TLS stream
+                w.close()
+            except (ConnectionError, asyncio.TimeoutError, OSError):
+                pass  # equally acceptable rejection
+
+            # wrong-CA client is refused by the mutual verification
+            import ssl
+            import subprocess
+
+            foreign = tmp_path / "foreign"
+            foreign.mkdir()
+            fcert, fkey, _fca = _make_certs(foreign, ca_name="other-ca")
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            # present the FOREIGN cert while trusting the REAL mesh CA:
+            # the handshake then fails only if the SERVER enforces
+            # client-cert verification (the mutual half under test)
+            ctx.load_cert_chain(fcert, fkey)
+            ctx.load_verify_locations(ca)
+            ctx.check_hostname = False
+            # TLS 1.3 delivers the server's bad-certificate alert on the
+            # first IO after the (client-side-complete) handshake: the
+            # attempted frame exchange must end in an error or EOF, never
+            # a gossip reply
+            try:
+                r, w = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port), ssl=ctx), 3.0)
+                payload = b'{"from":"evil","addr":"x","members":[],"state":[]}'
+                w.write(len(payload).to_bytes(4, "big") + payload)
+                await w.drain()
+                data = await asyncio.wait_for(r.read(100), 3.0)
+                assert data == b"", "server answered an unauthorized client"
+                w.close()
+            except (ssl.SSLError, ConnectionError, OSError,
+                    asyncio.TimeoutError):
+                pass  # rejected during/after handshake: equally correct
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_partial_tls_config_rejected():
+    with pytest.raises(ValueError, match="mTLS"):
+        GossipConfig(node_id="x", tls_cert_file="/tmp/c.crt")
