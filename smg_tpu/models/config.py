@@ -34,6 +34,8 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
+    # Qwen3-family: per-head RMSNorm on q/k before rope (q_norm/k_norm)
+    qk_norm: bool = False
     # ---- Gemma-2-family knobs (all default to llama semantics) ----
     activation: str = "silu"  # "silu" | "gelu_tanh"
     rms_unit_offset: bool = False  # RMSNorm scales by (1 + weight)
@@ -68,6 +70,8 @@ class ModelConfig:
         arch_names = cfg.get("architectures") or ["LlamaForCausalLM"]
         arch = "llama"
         name = arch_names[0].lower()
+        # Qwen3 family (dense and MoE) normalizes q/k per head before rope
+        qk_norm = "qwen3" in name
         if "qwen3moe" in name or "qwen2moe" in name:
             arch = "qwen_moe"
         elif "qwen" in name:
@@ -141,6 +145,7 @@ class ModelConfig:
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
             vision=vision,
             image_token_id=cfg.get("image_token_id"),
+            qk_norm=qk_norm,
             **extra,
         )
 

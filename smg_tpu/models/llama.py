@@ -65,6 +65,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         zero = jnp.zeros((L, E), dtype) if cfg.rms_unit_offset else jnp.ones((L, E), dtype)
         layers["post_attn_norm"] = zero
         layers["post_mlp_norm"] = zero
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm weights over head_dim for q and k
+        layers["q_norm"] = jnp.ones((L, D), dtype)
+        layers["k_norm"] = jnp.ones((L, D), dtype)
     if cfg.num_experts > 0:
         # MoE layers (Qwen-MoE family): router + stacked expert FFNs
         X = cfg.num_experts
@@ -101,6 +105,9 @@ def logical_axes(cfg: ModelConfig) -> Params:
     if cfg.post_norms:
         layers["post_attn_norm"] = ("layers", "embed")
         layers["post_mlp_norm"] = ("layers", "embed")
+    if cfg.qk_norm:
+        layers["q_norm"] = ("layers", "head_dim")
+        layers["k_norm"] = ("layers", "head_dim")
     if cfg.num_experts > 0:
         layers["router"] = ("layers", "embed", None)
         layers["w_gate"] = ("layers", "experts", "embed", "ffn")
@@ -215,6 +222,10 @@ def _qkv(layer: Params, cfg: ModelConfig, h: jnp.ndarray,
         q = q + _lora_delta(h, lora["wq_a"], lora["wq_b"], gates).reshape(q.shape)
         k = k + _lora_delta(h, lora["wk_a"], lora["wk_b"], gates).reshape(k.shape)
         v = v + _lora_delta(h, lora["wv_a"], lora["wv_b"], gates).reshape(v.shape)
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim before rope
+        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 

@@ -32,6 +32,9 @@ def _hf_key_map(cfg, n_layers: int) -> dict[str, tuple[str, ...]]:
         ("layers", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
         ("layers", "mlp_norm"): "model.layers.{i}.post_attention_layernorm.weight",
     }
+    if cfg.qk_norm:
+        m[("layers", "q_norm")] = "model.layers.{i}.self_attn.q_norm.weight"
+        m[("layers", "k_norm")] = "model.layers.{i}.self_attn.k_norm.weight"
     if cfg.post_norms:
         # Gemma-2 four-norm layers: HF's post_attention_layernorm is the
         # POST-attention norm there, and the ffn pre-norm is its own key

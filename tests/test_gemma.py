@@ -349,3 +349,100 @@ def test_pp_rejects_alternating_windows():
     )
     issues = validate_engine_config(cfg)
     assert any("alternation" in i.message for i in issues)
+
+
+def test_qwen3_qk_norm():
+    """Qwen3 parses + applies per-head q/k RMSNorm (real Qwen3 checkpoints
+    would silently be wrong without it)."""
+    import dataclasses
+    import threading
+
+    import jax.numpy as jnp
+
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["Qwen3ForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 128, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 8,
+        "num_key_value_heads": 2, "head_dim": 16,
+    })
+    assert cfg.qk_norm is True
+    cfg2 = ModelConfig.from_hf_config({
+        "architectures": ["Qwen2ForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 128, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 8,
+        "num_key_value_heads": 2,
+    })
+    assert cfg2.qk_norm is False
+    moe = ModelConfig.from_hf_config({
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 128, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 8,
+        "num_key_value_heads": 2, "num_experts": 4,
+        "num_experts_per_tok": 2, "moe_intermediate_size": 64,
+    })
+    assert moe.qk_norm is True and moe.arch == "qwen_moe"
+
+    from smg_tpu.models.weights import _hf_key_map
+
+    m = _hf_key_map(dataclasses.replace(tiny_test_config(), qk_norm=True), 4)
+    assert m[("layers", "q_norm")].endswith("self_attn.q_norm.weight")
+    assert m[("layers", "k_norm")].endswith("self_attn.k_norm.weight")
+
+    def gen(qk):
+        eng = Engine(EngineConfig(
+            model=dataclasses.replace(tiny_test_config(), qk_norm=qk),
+            cache=CacheConfig(page_size=16, num_pages=64, auto_size=False,
+                              dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=2, max_seq_len=128, max_prefill_tokens=32,
+                prefill_token_buckets=(32,), decode_batch_buckets=(2,),
+            ),
+            dtype="float32", model_id="tiny-q3",
+        ), tokenizer=MockTokenizer())
+        try:
+            assert ("q_norm" in eng.runner.params["layers"]) == qk
+            done = threading.Event()
+            acc = []
+
+            def cb(out):
+                acc.extend(out.new_token_ids)
+                if out.finished:
+                    done.set()
+
+            eng.submit(list(range(5, 25)),
+                       SamplingParams(temperature=0.0, max_new_tokens=6,
+                                      ignore_eos=True), on_output=cb)
+            for _ in range(200):
+                eng.step()
+                if done.is_set():
+                    return list(acc)
+            raise TimeoutError
+        finally:
+            eng.stop()
+
+    a, b = gen(True), gen(False)
+    assert len(a) == 6 and len(b) == 6
+
+    # logits-level oracle: the SAME weights with/without the q/k norm must
+    # produce different prefill logits (rms rescaling changes attention)
+    import jax
+
+    from smg_tpu.models import llama
+    from smg_tpu.ops.rope import rope_frequencies
+
+    qcfg = dataclasses.replace(tiny_test_config(), qk_norm=True)
+    params = llama.init_params(qcfg, jax.random.PRNGKey(0))
+    inv = jnp.asarray(rope_frequencies(qcfg.head_dim, qcfg.rope_theta, None))
+    kc = jnp.zeros((qcfg.num_layers, 8, 16,
+                    qcfg.num_kv_heads * qcfg.head_dim), jnp.float32)
+    toks = jnp.arange(5, 17, dtype=jnp.int32)
+    pt = jnp.arange(1, 3, dtype=jnp.int32)
+    lo_q, _, _ = llama.forward_prefill(
+        params, qcfg, inv, toks, jnp.int32(0), jnp.int32(12),
+        kc, jnp.zeros_like(kc), pt)
+    # same params sans the norm application (identity weights exist either way)
+    plain_cfg = dataclasses.replace(qcfg, qk_norm=False)
+    lo_p, _, _ = llama.forward_prefill(
+        params, plain_cfg, inv, toks, jnp.int32(0), jnp.int32(12),
+        jnp.zeros_like(kc), jnp.zeros_like(kc), pt)
+    assert not np.allclose(np.asarray(lo_q), np.asarray(lo_p), atol=1e-4)
