@@ -65,7 +65,9 @@ class EngineRequest:
     mrope_pos: Any = None
     mrope_delta: int = 0
     # speculative decoding: consecutive zero-acceptance verifies (back-off)
+    # + the request's incremental n-gram index (engine/speculative.py)
     spec_cold: int = 0
+    spec_index: Any = None
 
     @property
     def prompt_len(self) -> int:

@@ -550,9 +550,16 @@ class Scheduler:
                 and req.mrope_pos is None  # mrope verify: future work
                 and req.spec_cold < 3  # acceptance back-off
             )
-            proposals = (
-                propose_ngram(req.all_token_ids, cfg) if eligible else []
-            )
+            if eligible:
+                if req.spec_index is None:
+                    from smg_tpu.engine.speculative import NgramIndex
+
+                    req.spec_index = NgramIndex(cfg.ngram_min, cfg.ngram_max)
+                proposals = propose_ngram(
+                    req.all_token_ids, cfg, index=req.spec_index
+                )
+            else:
+                proposals = []
             # clip to the sequence bound: verify feeds 1 + len(proposals)
             # tokens and positions must stay within max_seq_len/page table
             if proposals:

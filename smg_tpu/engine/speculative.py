@@ -35,13 +35,28 @@ class SpecConfig:
 
 
 def propose_ngram(
-    token_ids: "list[int]", cfg: SpecConfig
+    token_ids: "list[int]", cfg: SpecConfig,
+    index: "NgramIndex | None" = None,
 ) -> "list[int]":
     """Prompt-lookup draft: longest suffix n-gram (ngram_max down to
     ngram_min) with an EARLIER occurrence inside the scan window proposes
     the up-to-max_draft tokens that followed it.  Empty list = nothing to
-    propose."""
+    propose.
+
+    ``index`` (per-request ``NgramIndex``) makes the lookup O(1) per call
+    with O(1) incremental updates per accepted token; without it the scan
+    is O(scan_window) — fine for tests, not the serving hot loop."""
     L = len(token_ids)
+    if index is not None:
+        index.extend(token_ids)
+        for n in range(min(cfg.ngram_max, L - 1), cfg.ngram_min - 1, -1):
+            suffix = tuple(token_ids[L - n:])
+            start = index.last_occurrence(suffix, before=L - n)
+            if start is not None and start >= L - cfg.scan_window:
+                follow = token_ids[start + n:start + n + cfg.max_draft]
+                if follow:
+                    return list(follow)
+        return []
     floor = max(0, L - cfg.scan_window)
     for n in range(min(cfg.ngram_max, L - 1), cfg.ngram_min - 1, -1):
         suffix = tuple(token_ids[L - n:])
@@ -52,6 +67,53 @@ def propose_ngram(
                 if follow:
                     return list(follow)
     return []
+
+
+class NgramIndex:
+    """Incremental n-gram -> latest-start-position map over a request's
+    token stream.  ``extend`` appends only the new tail (O(1) amortized per
+    token x ngram orders); ``last_occurrence`` is a dict probe.  The most
+    recent PRIOR occurrence is tracked with one level of history so the
+    suffix itself (which is also the latest occurrence) never shadows its
+    predecessor."""
+
+    def __init__(self, ngram_min: int = 1, ngram_max: int = 3):
+        self.ngram_min = ngram_min
+        self.ngram_max = ngram_max
+        self._count = 0
+        self._last_tok: int | None = None  # content check at _count-1
+        # ngram -> (latest_start, previous_start | None)
+        self._latest: dict[tuple, tuple] = {}
+
+    def extend(self, token_ids: "list[int]") -> None:
+        L = len(token_ids)
+        if L < self._count or (
+            self._count and self._last_tok != token_ids[self._count - 1]
+        ):
+            # the stream was trimmed/rewritten (stop-string rollback):
+            # indexed positions no longer describe the content — rebuild
+            self._latest.clear()
+            self._count = 0
+        for pos in range(self._count, L):
+            for n in range(self.ngram_min, self.ngram_max + 1):
+                start = pos - n + 1
+                if start < 0:
+                    continue
+                g = tuple(token_ids[start:start + n])
+                cur = self._latest.get(g)
+                self._latest[g] = (start, cur[0] if cur else None)
+        self._count = L
+        self._last_tok = token_ids[L - 1] if L else None
+
+    def last_occurrence(self, gram: tuple, before: int) -> "int | None":
+        """Most recent start strictly before ``before``."""
+        cur = self._latest.get(gram)
+        if cur is None:
+            return None
+        latest, prev = cur
+        if latest < before:
+            return latest
+        return prev if (prev is not None and prev < before) else None
 
 
 def accept_greedy(

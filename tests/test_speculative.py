@@ -150,3 +150,41 @@ def test_speculative_stop_conditions_respected():
     finally:
         eng.stop()
         plain.stop()
+
+
+def test_ngram_index_matches_scan():
+    """The incremental index returns the same proposals as the O(window)
+    scan on randomized streams (the serving hot path uses the index)."""
+    import random
+
+    from smg_tpu.engine.speculative import NgramIndex
+
+    rng = random.Random(0)
+    cfg = SpecConfig(max_draft=4, ngram_max=3, ngram_min=1)
+    for trial in range(50):
+        ids = [rng.randrange(6) for _ in range(rng.randrange(2, 60))]
+        idx = NgramIndex(cfg.ngram_min, cfg.ngram_max)
+        # grow incrementally like decode does
+        stream: list = []
+        for chunk in range(0, len(ids), 3):
+            stream = ids[: chunk + 3]
+            want = propose_ngram(stream, cfg)
+            got = propose_ngram(stream, cfg, index=idx)
+            assert got == want, (trial, stream, got, want)
+
+
+def test_ngram_index_survives_rollback():
+    """A stop-string-style trim rewrites the tail: the index detects the
+    content change and rebuilds instead of proposing from stale positions."""
+    from smg_tpu.engine.speculative import NgramIndex
+
+    cfg = SpecConfig(max_draft=4, ngram_max=2, ngram_min=1)
+    idx = NgramIndex(1, 2)
+    ids = [1, 2, 3, 1, 2, 3, 1, 2]
+    assert propose_ngram(ids, cfg, index=idx) == propose_ngram(ids, cfg)
+    # trim two tokens and diverge
+    ids2 = ids[:-2] + [9, 8, 9]
+    assert propose_ngram(ids2, cfg, index=idx) == propose_ngram(ids2, cfg)
+    # same length as an earlier state but different content
+    ids3 = ids2[:-1] + [7]
+    assert propose_ngram(ids3, cfg, index=idx) == propose_ngram(ids3, cfg)
