@@ -43,6 +43,10 @@ class TransferManager:
 
     #: seconds an un-pulled offer may live before being reclaimed
     OFFER_TTL = 120.0
+    #: seconds a reclaim self-pull may run before being abandoned
+    RECLAIM_TIMEOUT = 30.0
+    #: cached connections to remote transfer servers (LRU-evicted beyond)
+    MAX_CONNECTIONS = 32
 
     def __init__(self, device):
         self._device = device
@@ -50,6 +54,7 @@ class TransferManager:
         # RLock: pull() holds it across the server-property access
         self._lock = threading.RLock()
         self._next_uuid = int.from_bytes(os.urandom(6), "little") << 16
+        # insertion order doubles as LRU order (moved on hit)
         self._connections: dict[str, object] = {}
         # uuid -> (deadline, [(shape, dtype), ...]) for orphan reclamation
         self._pending: dict[int, tuple] = {}
@@ -102,10 +107,12 @@ class TransferManager:
 
     def reclaim(self, uuid: int) -> bool:
         """The decode leg failed before pulling: consume our own offer so
-        the server releases the pinned arrays.  Runs in a daemon thread —
-        if the decode leg DID pull concurrently (rare race) the self-pull
-        of a consumed uuid blocks forever, and a wedged daemon thread is
-        the contained failure mode."""
+        the server releases the pinned arrays.  Runs in a daemon thread.
+        If the decode leg DID pull concurrently (rare race) the self-pull
+        of a consumed uuid never completes — the transfer API has no
+        cancel, so the inner pull thread stays wedged, but the reclaim
+        wrapper joins with ``RECLAIM_TIMEOUT`` and logs the abandonment
+        instead of silently wedging the only record of the failure."""
         with self._lock:
             entry = self._pending.pop(uuid, None)
         if entry is None:
@@ -113,12 +120,24 @@ class TransferManager:
         _, specs = entry
         addr = self.address
 
-        def drain():
+        def do_pull():
             try:
                 self.pull(addr, uuid, specs)
                 logger.info("reclaimed abandoned kv offer %d", uuid)
             except Exception:
                 logger.exception("failed to reclaim kv offer %d", uuid)
+
+        def drain():
+            inner = threading.Thread(target=do_pull, daemon=True,
+                                     name=f"kv-reclaim-pull-{uuid}")
+            inner.start()
+            inner.join(self.RECLAIM_TIMEOUT)
+            if inner.is_alive():
+                logger.warning(
+                    "reclaim of kv offer %d did not finish in %gs — the "
+                    "decode leg likely pulled it concurrently; abandoning",
+                    uuid, self.RECLAIM_TIMEOUT,
+                )
 
         threading.Thread(target=drain, daemon=True,
                          name=f"kv-reclaim-{uuid}").start()
@@ -142,13 +161,25 @@ class TransferManager:
         import jax
 
         with self._lock:
-            conn = self._connections.get(address)
+            conn = self._connections.pop(address, None)
             if conn is None:
                 conn = self.server.connect(address)
-                self._connections[address] = conn
+            self._connections[address] = conn  # re-insert = LRU touch
+            while len(self._connections) > self.MAX_CONNECTIONS:
+                old_addr, _ = next(iter(self._connections.items()))
+                del self._connections[old_addr]
+                logger.info("evicted cached kv connection to %s", old_addr)
         sharding = jax.sharding.SingleDeviceSharding(self._device)
         specs = [
             jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
             for shape, dtype in shapes_dtypes
         ]
-        return conn.pull(uuid, specs)
+        try:
+            return conn.pull(uuid, specs)
+        except Exception:
+            # a failed pull usually means the peer is gone — drop the
+            # cached connection so the next call re-dials
+            with self._lock:
+                if self._connections.get(address) is conn:
+                    del self._connections[address]
+            raise

@@ -425,7 +425,9 @@ class Scheduler:
         use_lora = any(r.lora_idx for _, r in active)
         use_mrope = any(r.mrope_delta for _, r in active)
         horizon = 1 if use_mask else max(self.sched.decode_horizon, 1)
-        # ensure pages exist for the whole horizon's KV writes; may preempt
+        # ensure pages exist for the whole horizon's KV writes; may preempt.
+        # _ensure_seq_capacity refuses requests already evicted as a PEER's
+        # preemption victim earlier in this pass (incl. by the spec leg).
         survivors = []
         for i, req in active:
             if self._ensure_seq_capacity(req, horizon):
@@ -602,6 +604,11 @@ class Scheduler:
     def _ensure_seq_capacity(self, req: EngineRequest, n_tokens: int = 1) -> bool:
         """Make sure pages exist for positions seq_len..seq_len+n_tokens-1.
         Returns False if the request had to be preempted."""
+        if req.slot is None or req.status is RequestStatus.PREEMPTED:
+            # already evicted (e.g. as a peer's preemption victim this pass):
+            # page_tables[None] would numpy-broadcast over EVERY slot's row,
+            # corrupting all resident requests' page tables
+            return False
         limit = min(req.seq_len + n_tokens, self.sched.max_seq_len)
         needed = math.ceil(limit / self.ps)
         have = len(req.shared_pages) + len(req.owned_pages)

@@ -43,6 +43,10 @@ def quote_literal(value) -> str:
     if isinstance(value, bool):
         return "TRUE" if value else "FALSE"
     if isinstance(value, (int, float)):
+        if isinstance(value, float) and (value != value or value in (
+            float("inf"), float("-inf")
+        )):
+            raise ValueError(f"non-finite float in SQL literal: {value!r}")
         return repr(value)
     s = str(value)
     if "\x00" in s:
@@ -164,6 +168,19 @@ class PgClient:
         await self._writer.drain()
         await self._authenticate()
         # drain ParameterStatus/BackendKeyData until ReadyForQuery
+        while True:
+            kind, payload = await self._read_msg()
+            if kind == b"Z":
+                break
+            if kind == b"E":
+                raise PgError(self._parse_error(payload))
+        # quote_literal's ''-doubling is only sound under standard-conforming
+        # strings; pin the GUC so a legacy server (scs=off) can't turn
+        # backslashes in user-controlled values into an escape vector
+        self._writer.write(
+            self._msg(b"Q", b"SET standard_conforming_strings = on\x00")
+        )
+        await self._writer.drain()
         while True:
             kind, payload = await self._read_msg()
             if kind == b"Z":

@@ -50,6 +50,16 @@ MIGRATIONS = [
         metadata TEXT NOT NULL
     );
     """,
+    # v2: item ordering moves from a process-local counter to a server-side
+    # sequence so concurrent gateway instances can never mint colliding seq
+    # values.  The setval runs once here (not per startup) — the only race
+    # window is an old-version instance still inserting literal seqs during
+    # this migration, vs. every restart with the counter scheme.
+    """
+    CREATE SEQUENCE IF NOT EXISTS conversation_items_seq;
+    SELECT setval('conversation_items_seq', GREATEST(
+        (SELECT COALESCE(MAX(seq), 0) FROM conversation_items), 1));
+    """,
 ]
 
 
@@ -59,7 +69,6 @@ class PostgresStorage(ConversationStorage, ConversationItemStorage, ResponseStor
             client = PgClient.from_dsn(dsn or "postgres://postgres@127.0.0.1/postgres")
         self.client = client
         self._migrated = False
-        self._seq = 0
 
     async def _ensure(self) -> None:
         if self._migrated:
@@ -79,13 +88,6 @@ class PostgresStorage(ConversationStorage, ConversationItemStorage, ResponseStor
             await self.client.query(
                 f"INSERT INTO smg_migrations VALUES ({i}, {time.time()})"
             )
-        # resume the item sequence where the table left off — a fresh
-        # process-local counter would interleave new turns into old history
-        # after a restart (and collide across gateway instances)
-        rows = await self.client.query(
-            "SELECT COALESCE(MAX(seq), 0) AS s FROM conversation_items"
-        )
-        self._seq = max(self._seq, int(rows[0]["s"] or 0))
         self._migrated = True
 
     async def close(self) -> None:
@@ -154,11 +156,11 @@ class PostgresStorage(ConversationStorage, ConversationItemStorage, ResponseStor
         await self._ensure()
         for item in items:
             item.conversation_id = conv_id
-            self._seq += 1
             await self.client.query(
                 "INSERT INTO conversation_items VALUES ("
                 f"{q(item.id)}, {q(conv_id)}, {q(item.type)}, {q(item.role)}, "
-                f"{q(json.dumps(item.content))}, {item.created_at}, {self._seq})"
+                f"{q(json.dumps(item.content))}, {item.created_at}, "
+                "nextval('conversation_items_seq'))"
             )
         return items
 

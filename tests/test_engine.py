@@ -175,6 +175,24 @@ def test_page_pressure_preemption():
         assert total == 40
 
 
+def test_ensure_seq_capacity_refuses_preempted_request():
+    """A request evicted as a peer's preemption victim earlier in the same
+    decode pass has slot=None; _ensure_seq_capacity must refuse it instead
+    of numpy-broadcasting a page id over the whole page table
+    (ADVICE r4 medium)."""
+    eng = make_engine(num_pages=32, max_batch=4)
+    eng.submit(list(range(5, 25)), greedy(64), on_output=lambda o: None)
+    eng.step()  # prefill: request becomes resident
+    sched = eng.scheduler
+    victim = next(r for r in sched.slots if r is not None)
+    sched._preempt(victim)
+    after_preempt = sched.page_tables.copy()
+    assert not sched._ensure_seq_capacity(victim, 4)
+    # the preempted request must not have touched any OTHER slot's rows
+    assert (sched.page_tables == after_preempt).all()
+    assert victim.slot is None
+
+
 def test_loads_reporting(engine):
     loads = engine.loads()
     assert loads["num_running"] == 0

@@ -9,6 +9,7 @@ import base64
 import hashlib
 import hmac
 import os
+import re
 import sqlite3
 import struct
 
@@ -244,6 +245,7 @@ class FakePg:
 
     def __init__(self):
         self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self.seqs: dict[str, int] = {}
 
     @staticmethod
     def _msg(kind: bytes, payload: bytes) -> bytes:
@@ -314,15 +316,39 @@ class FakePg:
         writer.write(self._msg(b"R", struct.pack(">I", 0)))
         await writer.drain()
 
+    def _nextval(self, m: re.Match) -> str:
+        name = m.group(1)
+        self.seqs[name] = self.seqs.get(name, 0) + 1
+        return str(self.seqs[name])
+
     def _query(self, sql: str, writer) -> None:
-        # dialect shims: sqlite has no DOUBLE PRECISION/BIGINT distinctions
+        # dialect shims: sqlite has no DOUBLE PRECISION/BIGINT distinctions,
+        # SET, or sequences — sequences are emulated in self.seqs
         shimmed = (sql.replace("DOUBLE PRECISION", "REAL")
                       .replace("BIGINT", "INTEGER"))
+        shimmed = re.sub(r"nextval\('(\w+)'\)", self._nextval, shimmed)
         try:
             cur = self.db.cursor()
             rows = []
             for stmt in [s for s in shimmed.split(";") if s.strip()]:
-                cur.execute(stmt)
+                s = stmt.strip()
+                if s.upper().startswith("SET "):
+                    continue
+                m = re.match(r"CREATE SEQUENCE IF NOT EXISTS (\w+)", s, re.I)
+                if m:
+                    self.seqs.setdefault(m.group(1), 0)
+                    continue
+                m = re.match(r"SELECT setval\('(\w+)',", s, re.I)
+                if m:
+                    name = m.group(1)
+                    cur.execute("SELECT COALESCE(MAX(seq), 0) "
+                                "FROM conversation_items")
+                    table_max = int(cur.fetchone()[0])
+                    self.seqs[name] = max(self.seqs.get(name, 0), table_max)
+                    cur.execute(f"SELECT {self.seqs[name]} AS setval")
+                    rows = cur.fetchall()
+                    continue
+                cur.execute(s)
                 if cur.description is not None:
                     rows = cur.fetchall()
             self.db.commit()
