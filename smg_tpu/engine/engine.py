@@ -127,14 +127,13 @@ class Engine:
             if grids and self.config.model.mrope_section is not None:
                 # Qwen2-VL M-RoPE: 3-axis position ids per token + the
                 # decode delta (engine/mrope.py)
-                if self.runner.use_pp or self.config.parallel.sp > 1:
+                if self.config.parallel.sp > 1:
                     # reject HERE — deep in the step loop the error would
                     # wedge an admitted request in its slot forever (the
-                    # runner refuses M-RoPE under pp AND under ring/sp
-                    # prefill)
+                    # runner refuses M-RoPE under ring/sp prefill; pp
+                    # composes since r5 — rope ids ride the pp consts)
                     raise ValueError(
-                        "M-RoPE image requests are not supported with "
-                        "serving pp/sp yet"
+                        "M-RoPE image requests are not supported with sp yet"
                     )
                 from smg_tpu.engine.mrope import (
                     image_runs_from_positions,

@@ -209,8 +209,6 @@ class ModelRunner:
         Crossover measured at ~100k gathered tokens (1B model, v5e)."""
         if self.use_pp:
             return "xla"  # pallas kernels don't run inside the pp shard_map
-        if self.model_cfg.attn_logit_softcap or self.model_cfg.sliding_window:
-            return "xla"  # kernels lack softcap/sliding-window masks
         if self.attn_impl != "auto":
             return self.attn_impl
         return "pallas" if B * mp * self.spec.page_size > 131072 else "xla"
@@ -224,8 +222,6 @@ class ModelRunner:
         cheap)."""
         if self.use_pp:
             return "xla"
-        if self.model_cfg.attn_logit_softcap or self.model_cfg.sliding_window:
-            return "xla"  # kernels lack softcap/sliding-window masks
         if self.attn_impl == "xla":
             return "xla"
         d = self.model_cfg.head_dim
@@ -316,9 +312,6 @@ class ModelRunner:
     def load_lora(self, name: str, weights: dict) -> int:
         """Install (or replace) an adapter in the bank; returns its slot."""
         from smg_tpu.models.lora import canonical_keys, validate_adapter
-
-        if self.use_pp:
-            raise ValueError("LoRA adapters are not supported with serving pp yet")
 
         rank = validate_adapter(self.model_cfg, weights)
         N = self.lora_slots
@@ -443,14 +436,16 @@ class ModelRunner:
 
     def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False,
                             use_pen: bool = False, use_mask: bool = False,
-                            use_lora: bool = False, use_embeds: bool = False):
+                            use_lora: bool = False, use_embeds: bool = False,
+                            use_mrope: bool = False):
         k = ("prefill_batched", G, T, mp, no_ctx, use_pen, use_mask, use_lora,
-             use_embeds)
+             use_embeds, use_mrope)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
         n_slots = self.lora_slots
+        pp_mesh = self.mesh if self.use_pp else None
 
         def step(params, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
                  key, temps, topks, topps, minps, *extra):
@@ -470,10 +465,13 @@ class ModelRunner:
             input_embeds = embeds_mask = None
             if use_embeds:
                 input_embeds, embeds_mask = extra[i], extra[i + 1]
+                i += 2
+            rope_pos = extra[i] if use_mrope else None
             logits, kc, vc = module.forward_prefill_batched(
                 params, cfg, inv_freq, tokens, prefix_lens, t_reals, kc, vc, page_tables,
                 no_ctx=no_ctx, lora=lora_bank, lora_gates=lora_gates,
                 input_embeds=input_embeds, embeds_mask=embeds_mask,
+                rope_pos=rope_pos, pp_mesh=pp_mesh,
             )
             if use_pen:
                 logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
@@ -482,7 +480,8 @@ class ModelRunner:
             return toks, lps, kc, vc
 
         n_extra = ((5 if use_pen else 0) + (1 if use_mask else 0)
-                   + (2 if use_lora else 0) + (2 if use_embeds else 0))
+                   + (2 if use_lora else 0) + (2 if use_embeds else 0)
+                   + (1 if use_mrope else 0))
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -510,6 +509,7 @@ class ModelRunner:
         mask: np.ndarray | None = None,  # [G_real, V] bool
         lora_idx: np.ndarray | None = None,  # [G_real] adapter slot per row
         mm: "list[tuple | None] | None" = None,  # per-row (dense [t,E], bool [t])
+        rope: "list[np.ndarray | None] | None" = None,  # per-row [3, t] M-RoPE ids
     ) -> tuple[np.ndarray, np.ndarray]:
         """Prefill several single-chunk sequences in one call.
         Returns (tokens [G_real], logprobs [G_real])."""
@@ -541,11 +541,13 @@ class ModelRunner:
         no_ctx = all(c[1] == 0 for c in chunks)
         use_lora = lora_idx is not None and self._lora_bank is not None
         use_embeds = mm is not None and any(m is not None for m in mm)
+        use_mrope = rope is not None and any(r is not None for r in rope)
         fn = self._prefill_batched_fn(G, T, mp, no_ctx,
                                       use_pen=pen is not None,
                                       use_mask=mask is not None,
                                       use_lora=use_lora,
-                                      use_embeds=use_embeds)
+                                      use_embeds=use_embeds,
+                                      use_mrope=use_mrope)
         args = [
             self.params,
             self.inv_freq,
@@ -587,6 +589,16 @@ class ModelRunner:
                     dense[i, : d.shape[0]] = d
                     emask[i, : bm.shape[0]] = bm
             args += [jnp.asarray(dense), jnp.asarray(emask)]
+        if use_mrope:
+            # default rows: all three axes = sequential position, which makes
+            # apply_mrope EXACTLY apply_rope for the text rows in the group
+            rp = np.broadcast_to(
+                (prefix_lens[:, None] + np.arange(T))[:, None, :], (G, 3, T)
+            ).astype(np.int32).copy()
+            for i, r in enumerate(rope):
+                if r is not None:
+                    rp[i, :, : r.shape[1]] = r
+            args.append(jnp.asarray(rp))
         toks, lps, self.k_cache, self.v_cache = fn(*args)
         return np.asarray(toks)[:g_real], np.asarray(lps)[:g_real]
 
@@ -724,8 +736,6 @@ class ModelRunner:
         use_mask = mask is not None
         use_lora = lora_idx is not None and self._lora_bank is not None
         use_mrope = rope_delta is not None
-        if use_mrope and self.use_pp:
-            raise ValueError("M-RoPE does not compose with serving pp yet")
         fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask, use_lora,
                                    use_mrope)
         args = [
@@ -838,8 +848,8 @@ class ModelRunner:
             self.mesh is not None and sp > 1 and prefix_len == 0 and T % sp == 0
             and not self.use_pp  # ring + pp composition is future work
         )
-        if rope_pos is not None and (self.use_pp or use_ring):
-            raise ValueError("M-RoPE does not compose with pp/ring prefill yet")
+        if rope_pos is not None and use_ring:
+            raise ValueError("M-RoPE does not compose with ring prefill yet")
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
                               use_mask=mask is not None, use_lora=use_lora,
                               use_ring=use_ring, use_embeds=mm is not None,
@@ -896,6 +906,7 @@ class ModelRunner:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
+        pp_mesh = self.mesh if self.use_pp else None
 
         def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc,
                  page_table, *extra):
@@ -903,6 +914,7 @@ class ModelRunner:
             logits, kc, vc = module.forward_prefill(
                 params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc,
                 page_table, attn_impl=impl, rope_pos=rope_pos,
+                pp_mesh=pp_mesh,
                 all_logits=True,
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
@@ -936,8 +948,6 @@ class ModelRunner:
         mp = len(page_table)
         if prefix_len + t > mp * ps:
             raise ValueError("verify chunk overruns page table")
-        if self.use_pp:
-            raise ValueError("speculative verify under serving pp is future work")
         tokens = np.zeros(T, np.int32)
         tokens[:t] = token_ids
         fn = self._verify_fn(T, mp, use_mrope=rope_pos is not None)

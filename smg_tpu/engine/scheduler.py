@@ -196,11 +196,10 @@ class Scheduler:
                 self.slots[slot] = req
 
                 remaining = len(prompt) - matched_tokens
-                if (remaining > self.sched.max_prefill_tokens
-                        or getattr(self.runner, "use_pp", False)
-                        or req.mrope_pos is not None):
-                    # pp serving + M-RoPE requests: grouped prefill isn't
-                    # wired for either yet — use the solo chunk loop
+                if remaining > self.sched.max_prefill_tokens:
+                    # long prompts chunk through the solo loop; short ones
+                    # batch — including under serving pp and M-RoPE (the
+                    # grouped forward takes pp_mesh + per-row rope ids)
                     self._prefill_solo(req, prompt, matched_tokens, outputs)
                 else:
                     # mm requests batch like text: the group path splices
@@ -368,11 +367,13 @@ class Scheduler:
         use_lora = any(r.lora_idx for r in group)
         lora_idx = np.array([r.lora_idx for r in group], np.int32) if use_lora else None
         mm_rows: list = []
+        rope_rows: list = []
         for i, req in enumerate(group):
             prompt = req.all_token_ids
             chunk = prompt[req.cached_tokens :]
             chunks.append((chunk, req.cached_tokens, self.page_tables[req.slot]))
             mm_rows.append(self._mm_chunk(req, req.cached_tokens, len(chunk)))
+            rope_rows.append(self._mrope_chunk(req, req.cached_tokens, len(chunk)))
             sp = req.sampling
             temps[i] = sp.temperature
             topks[i] = sp.top_k
@@ -392,6 +393,7 @@ class Scheduler:
             mask=mask_arr,
             lora_idx=lora_idx,
             mm=mm_rows if any(m is not None for m in mm_rows) else None,
+            rope=rope_rows if any(r is not None for r in rope_rows) else None,
         )
         for i, req in enumerate(group):
             req.seq_len = req.total_len
@@ -519,7 +521,8 @@ class Scheduler:
         the normal batched decode should still handle.
 
         Eligible = greedy, unconstrained, penalty-free, no logprobs, no
-        LoRA/M-RoPE (the verify pass scores BASE-model argmaxes only).
+        LoRA (the verify pass scores BASE-model argmaxes only); M-RoPE
+        requests verify with text rope ids + delta.
         Each verify feeds [last_token, drafts...] as one prefill-shaped
         forward and accepts the longest matching prefix + the model's own
         next token — >= 1 token per call.  Caveats the adaptive back-off
@@ -549,7 +552,6 @@ class Scheduler:
                 and not sp.logprobs
                 and not req.lora_idx  # verify runs the BASE weights only
                 and req.output_ids
-                and req.mrope_pos is None  # mrope verify: future work
                 and req.spec_cold < 3  # acceptance back-off
             )
             if eligible:
@@ -589,6 +591,9 @@ class Scheduler:
             arg = self.runner.verify(
                 chunk, prefix_len=req.seq_len,
                 page_table=self.page_tables[slot][:mp_b],
+                # M-RoPE: generated positions are text (3 equal axes + delta),
+                # exactly what _mrope_chunk emits past the prompt
+                rope_pos=self._mrope_chunk(req, req.seq_len, len(chunk)),
             )
             accepted, n_hits = accept_greedy(proposals, [int(a) for a in arg])
             self.num_spec_drafted += len(proposals)

@@ -239,6 +239,14 @@ def _attn_out(layer: Params, attn: jnp.ndarray, lora: Params | None = None,
     return o
 
 
+
+def _scan_xs(layers, lora, num_layers):
+    """Layer-scan xs: ``(layer, lora_layer, index)`` when a LoRA bank rides
+    along, else ``(layer, index)`` — shared by the plain scans here and the
+    pp shard_map bodies (``parallel/pp_serving.py``)."""
+    idx = jnp.arange(num_layers)
+    return (layers, lora, idx) if lora is not None else (layers, idx)
+
 def _mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     if "router" in layer:
         return _moe_mlp(layer, h, cfg)
@@ -301,8 +309,8 @@ def forward_prefill(
     chunks extending a cached prefix use the dense gather path.
 
     ``pp_mesh`` (serving PP, ``parallel/pp_serving.py``): layer stack + KV
-    cache sharded over ``pp``; mutually exclusive with sp/pallas/LoRA (the
-    runner enforces the XLA path)."""
+    cache (and any LoRA bank) sharded over ``pp``; mutually exclusive with
+    sp/pallas (the runner enforces the XLA path)."""
     T = tokens.shape[0]
     if lora is not None:
         lora_gates = jnp.broadcast_to(lora_gates, (T, lora_gates.shape[-1]))
@@ -324,9 +332,12 @@ def forward_prefill(
         # (reference: EPD encode leg shipping embeddings to prefill)
         h = jnp.where(embeds_mask[:, None], input_embeds.astype(h.dtype), h)
 
-    def make_body(pos, dest, page_table, ctx_len, inv_freq):
+    def make_body(pos, dest, page_table, ctx_len, inv_freq, rope_pos,
+                  lora_gates):
         """Layer-body factory: pp runs it under shard_map with per-stage
-        consts, the plain path calls it once with the outer tracers."""
+        consts (everything data-dependent rides the consts tuple so the
+        body never closes over an outer tracer), the plain path calls it
+        once with the outer tracers."""
 
         def layer_body(carry, xs):
             h, k_cache, v_cache = carry
@@ -359,6 +370,8 @@ def forward_prefill(
                 attn = paged_attention_prefill(
                     q, k.reshape(T, -1), v.reshape(T, -1), k_cache, v_cache, l,
                     page_table, prefix_len, t_real, scale,
+                    softcap=cfg.attn_logit_softcap,
+                    window=_layer_window(cfg, l),
                     interpret=(attn_impl == "pallas_interpret"),
                 )
             else:
@@ -375,22 +388,18 @@ def forward_prefill(
         return layer_body
 
     if pp_mesh is not None:
-        if lora is not None:
-            raise ValueError("LoRA is not supported with serving pp yet")
         from smg_tpu.parallel.pp_serving import pp_serving_scan
 
         h, k_cache, v_cache = pp_serving_scan(
             pp_mesh, make_body, h, k_cache, v_cache, params["layers"],
-            (pos, dest, page_table, ctx_len, inv_freq),
+            (pos, dest, page_table, ctx_len, inv_freq, rope_pos, lora_gates),
+            lora=lora,
         )
     else:
-        xs = (
-            (params["layers"], lora, jnp.arange(cfg.num_layers))
-            if lora is not None
-            else (params["layers"], jnp.arange(cfg.num_layers))
-        )
+        xs = _scan_xs(params["layers"], lora, cfg.num_layers)
         (h, k_cache, v_cache), _ = jax.lax.scan(
-            make_body(pos, dest, page_table, ctx_len, inv_freq),
+            make_body(pos, dest, page_table, ctx_len, inv_freq, rope_pos,
+                      lora_gates),
             (h, k_cache, v_cache), xs,
         )
     if all_logits:
@@ -454,11 +463,7 @@ def forward_decode(
         h = _mlp_residual(h, layer, cfg)
         return (h, k_cache, v_cache), None
 
-    xs = (
-        (params["layers"], lora, jnp.arange(cfg.num_layers))
-        if lora is not None
-        else (params["layers"], jnp.arange(cfg.num_layers))
-    )
+    xs = _scan_xs(params["layers"], lora, cfg.num_layers)
     (h, k_cache, v_cache), _ = jax.lax.scan(
         layer_body, (h, k_cache, v_cache), xs
     )
@@ -481,6 +486,8 @@ def forward_prefill_batched(
     lora_gates: jnp.ndarray | None = None,  # [G, N] one-hot per sequence
     input_embeds: jnp.ndarray | None = None,  # [G, T, E] mm splice rows
     embeds_mask: jnp.ndarray | None = None,  # [G, T] bool: row from input_embeds
+    rope_pos: jnp.ndarray | None = None,  # [G, 3, T] M-RoPE position ids
+    pp_mesh=None,  # Mesh: serving pipeline parallelism over the "pp" axis
 ):
     """Prefill several sequences in one device call (fills the MXU and
     amortizes dispatch; single-sequence prefill wastes both).  Returns
@@ -514,45 +521,69 @@ def forward_prefill_batched(
             lora_gates[:, None, :], (G_, T, lora_gates.shape[-1])
         )
 
-    def layer_body(carry, xs):
-        h, k_cache, v_cache = carry
-        if lora is not None:
-            layer, lor, l = xs
-        else:
-            (layer, l), lor = xs, None
-        hn = _norm(h, layer["attn_norm"], cfg)
-        q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [G, T, H/K, D]
-        q = apply_rope(q, pos, inv_freq)
-        k = apply_rope(k, pos, inv_freq)
-        k_cache, v_cache = scatter_kv_pages_full(
-            k_cache, v_cache, l, k.reshape(G_ * T, K, D), v.reshape(G_ * T, K, D), dest
-        )
-        if no_ctx:
-            # cold prompts: the chunk IS the whole context
-            attn = attention_prefill_batched(q, k, v, pos, ctx_lens, scale,
-                                             softcap=cfg.attn_logit_softcap,
-                                             window=_layer_window(cfg, l))
-        else:
-            kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
-            vl = v_cache[l][page_tables]
-            S = mp * ps
-            k_ctx = kl.reshape(G_, S, K, D)
-            v_ctx = vl.reshape(G_, S, K, D)
-            attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens, scale,
-                                             softcap=cfg.attn_logit_softcap,
-                                             window=_layer_window(cfg, l))
-        h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
-        h = _mlp_residual(h, layer, cfg)
-        return (h, k_cache, v_cache), None
+    def make_body(pos, dest, page_tables, ctx_lens, inv_freq, rope_pos,
+                  lora_gates):
+        """Layer-body factory mirroring ``forward_prefill``'s: pp runs it
+        under shard_map with per-stage consts."""
 
-    xs = (
-        (params["layers"], lora, jnp.arange(cfg.num_layers))
-        if lora is not None
-        else (params["layers"], jnp.arange(cfg.num_layers))
-    )
-    (h, k_cache, v_cache), _ = jax.lax.scan(
-        layer_body, (h, k_cache, v_cache), xs
-    )
+        def layer_body(carry, xs):
+            h, k_cache, v_cache = carry
+            if lora is not None:
+                layer, lor, l = xs
+            else:
+                (layer, l), lor = xs, None
+            hn = _norm(h, layer["attn_norm"], cfg)
+            q, k, v = _qkv(layer, cfg, hn, lor, lora_gates)  # [G, T, H/K, D]
+            if rope_pos is not None:
+                # M-RoPE rows rotate sectioned frequencies; masks and cache
+                # destinations keep the sequential ``pos``
+                from smg_tpu.ops.rope import apply_mrope
+
+                q = apply_mrope(q, rope_pos, inv_freq, cfg.mrope_section)
+                k = apply_mrope(k, rope_pos, inv_freq, cfg.mrope_section)
+            else:
+                q = apply_rope(q, pos, inv_freq)
+                k = apply_rope(k, pos, inv_freq)
+            k_cache, v_cache = scatter_kv_pages_full(
+                k_cache, v_cache, l, k.reshape(G_ * T, K, D),
+                v.reshape(G_ * T, K, D), dest
+            )
+            if no_ctx:
+                # cold prompts: the chunk IS the whole context
+                attn = attention_prefill_batched(q, k, v, pos, ctx_lens, scale,
+                                                 softcap=cfg.attn_logit_softcap,
+                                                 window=_layer_window(cfg, l))
+            else:
+                kl = k_cache[l][page_tables]  # [G, mp, ps, KD]
+                vl = v_cache[l][page_tables]
+                S = mp * ps
+                k_ctx = kl.reshape(G_, S, K, D)
+                v_ctx = vl.reshape(G_, S, K, D)
+                attn = attention_prefill_batched(q, k_ctx, v_ctx, pos, ctx_lens,
+                                                 scale,
+                                                 softcap=cfg.attn_logit_softcap,
+                                                 window=_layer_window(cfg, l))
+            h = _attn_residual(h, layer, attn, cfg, lor, lora_gates)
+            h = _mlp_residual(h, layer, cfg)
+            return (h, k_cache, v_cache), None
+
+        return layer_body
+
+    if pp_mesh is not None:
+        from smg_tpu.parallel.pp_serving import pp_serving_scan
+
+        h, k_cache, v_cache = pp_serving_scan(
+            pp_mesh, make_body, h, k_cache, v_cache, params["layers"],
+            (pos, dest, page_tables, ctx_lens, inv_freq, rope_pos, lora_gates),
+            lora=lora,
+        )
+    else:
+        xs = _scan_xs(params["layers"], lora, cfg.num_layers)
+        (h, k_cache, v_cache), _ = jax.lax.scan(
+            make_body(pos, dest, page_tables, ctx_lens, inv_freq, rope_pos,
+                      lora_gates),
+            (h, k_cache, v_cache), xs
+        )
     last_idx = jnp.maximum(t_reals - 1, 0)[:, None, None]  # [G, 1, 1]
     last = jnp.take_along_axis(
         h, jnp.broadcast_to(last_idx, (G_, 1, h.shape[-1])).astype(jnp.int32), axis=1
@@ -598,11 +629,11 @@ def forward_decode_horizon(
     h = embed_tokens(params, cfg, tokens)  # [B, E]
 
     def make_body(positions, step_idx, entry_positions, page_tables, inv_freq,
-                  k_cache, v_cache):
+                  rope_delta, lora_gates, k_cache, v_cache):
         # generated tokens are text: all three M-RoPE axes are equal, so
         # decode stays on the standard rope path with a per-slot offset.
         # Computed from make_body's own params so the pp shard_map never
-        # closes over an outer tracer (rope_delta is rejected under pp).
+        # closes over an outer tracer (rope_delta/lora_gates ride consts).
         rope_positions = (
             positions if rope_delta is None else positions + rope_delta
         )
@@ -633,6 +664,8 @@ def forward_decode_horizon(
                 attn = paged_attention_decode_cached(
                     q, k_cache, v_cache, hk_l, hv_l, step_idx + 1, l,
                     page_tables, entry_positions, scale,
+                    softcap=cfg.attn_logit_softcap,
+                    window=_layer_window(cfg, l),
                 )
             else:
                 attn = attention_decode_cached(
@@ -648,24 +681,20 @@ def forward_decode_horizon(
         return layer_body
 
     if pp_mesh is not None:
-        if lora is not None:
-            raise ValueError("LoRA is not supported with serving pp yet")
         from smg_tpu.parallel.pp_serving import pp_decode_scan
 
         h, hk_all, hv_all = pp_decode_scan(
             pp_mesh, make_body, h, hk_all, hv_all, k_cache, v_cache,
             params["layers"],
-            (positions, step_idx, entry_positions, page_tables, inv_freq),
+            (positions, step_idx, entry_positions, page_tables, inv_freq,
+             rope_delta, lora_gates),
+            lora=lora,
         )
     else:
-        xs = (
-            (params["layers"], lora, jnp.arange(cfg.num_layers))
-            if lora is not None
-            else (params["layers"], jnp.arange(cfg.num_layers))
-        )
+        xs = _scan_xs(params["layers"], lora, cfg.num_layers)
         (h, hk_all, hv_all), _ = jax.lax.scan(
             make_body(positions, step_idx, entry_positions, page_tables,
-                      inv_freq, k_cache, v_cache),
+                      inv_freq, rope_delta, lora_gates, k_cache, v_cache),
             (h, hk_all, hv_all), xs,
         )
     logits = unembed(params, cfg, h)

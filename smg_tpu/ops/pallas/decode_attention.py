@@ -42,7 +42,7 @@ def _decode_kernel(
     # scalar prefetch
     page_tables_ref,  # [B, mp] int32 (SMEM)
     entry_pos_ref,  # [B] int32 (SMEM) — tokens in cache (exclusive bound)
-    meta_ref,  # [2] int32 (SMEM): [n_extra, layer]
+    meta_ref,  # [3] int32 (SMEM): [n_extra, layer, window] (window<=0 = global)
     # inputs
     q_ref,  # [1, H, KD] VMEM (block-diagonal query for this sequence)
     hk_ref,  # [1, N, KD] VMEM (horizon side buffer, rows 0..n_extra-1 valid)
@@ -60,6 +60,7 @@ def _decode_kernel(
     *,
     ps: int,
     scale: float,
+    softcap: float,
 ):
     b = pl.program_id(0)
     H = q_ref.shape[1]
@@ -67,12 +68,20 @@ def _decode_kernel(
     mp = page_tables_ref.shape[1]
     n_extra = meta_ref[0]
     layer = meta_ref[1]
+    window = meta_ref[2]
 
     entry = entry_pos_ref[b]
     total_slots = mp * ps
     is_pad = entry >= total_slots
     # cache holds tokens 0..entry-1
     n_pages = jnp.where(is_pad, 0, (entry + ps - 1) // ps)
+    # sliding window: the query sits at entry + n_extra - 1; keys below
+    # ``lo`` are outside the window, so whole pages below it are SKIPPED —
+    # the DMA loop starts at the window's first live page, which is the
+    # point of sliding-window attention at long contexts (Mistral W=4096)
+    q_pos = entry + n_extra - 1
+    lo = jnp.where(window > 0, jnp.maximum(q_pos - window + 1, 0), 0)
+    start_page = jnp.minimum(lo // ps, n_pages)
 
     def dma(i, slot):
         page = page_tables_ref[b, i]
@@ -97,11 +106,16 @@ def _decode_kernel(
     stat_ref[:, 0:128] = jnp.full((H, 128), NEG_INF, jnp.float32)
     stat_ref[:, 128:256] = jnp.zeros((H, 128), jnp.float32)
 
-    @pl.when(n_pages > 0)
+    @pl.when(n_pages > start_page)
     def _prologue():
-        start_dma(0, 0)
+        start_dma(start_page, jax.lax.rem(start_page, 2))
 
     q = q_ref[0].astype(jnp.float32)  # [H, KD] block-diagonal
+
+    def cap(scores):
+        if softcap:
+            return softcap * jnp.tanh(scores / softcap)
+        return scores
 
     def merge(scores, v_block):
         """Online-softmax merge of one score block [H, S] with values [S, KD]."""
@@ -128,31 +142,31 @@ def _decode_kernel(
         wait_dma(i, slot)
         k = k_buf[slot].astype(jnp.float32)  # [ps, KD]
         v = v_buf[slot].astype(jnp.float32)
-        scores = jax.lax.dot_general(
+        scores = cap(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [H, ps]
+        ) * scale)  # [H, ps]
         slot_pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (H, ps), 1)
-        scores = jnp.where(slot_pos < entry, scores, NEG_INF)
+        scores = jnp.where((slot_pos < entry) & (slot_pos >= lo), scores, NEG_INF)
         merge(scores, v)
         return 0
 
-    jax.lax.fori_loop(0, n_pages, body, 0)
+    jax.lax.fori_loop(start_page, n_pages, body, 0)
 
-    # in-flight horizon tokens
+    # in-flight horizon tokens (side rows sit at positions entry + col)
     hk = hk_ref[0].astype(jnp.float32)  # [N, KD]
     hv = hv_ref[0].astype(jnp.float32)
-    s_extra = jax.lax.dot_general(
+    s_extra = cap(jax.lax.dot_general(
         q, hk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [H, N]
+    ) * scale)  # [H, N]
     col = jax.lax.broadcasted_iota(jnp.int32, (H, N), 1)
-    s_extra = jnp.where(col < n_extra, s_extra, NEG_INF)
+    s_extra = jnp.where((col < n_extra) & (entry + col >= lo), s_extra, NEG_INF)
     merge(s_extra, hv)
 
     l = stat_ref[:, 128:129]
     out_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "softcap", "interpret"))
 def paged_attention_decode_cached(
     q: jax.Array,  # [B, H, D] post-rope queries
     k_cache: jax.Array,  # [L, P, ps, K*D] read-only cache (fused lanes)
@@ -164,6 +178,8 @@ def paged_attention_decode_cached(
     page_tables: jax.Array,  # [B, mp] int32
     entry_positions: jax.Array,  # [B] int32: cache token count at horizon entry
     scale: float,
+    softcap: float | None = None,  # tanh softcap on attn logits (Gemma-2)
+    window=None,  # scalar int32 sliding window (None/<=0 = global)
     interpret: bool = False,
 ) -> jax.Array:
     B, H, D = q.shape
@@ -182,9 +198,14 @@ def paged_attention_decode_cached(
 
     k2 = k_cache.reshape(L, P * ps, KD)
     v2 = v_cache.reshape(L, P * ps, KD)
-    meta = jnp.stack([jnp.asarray(n_extra, jnp.int32), jnp.asarray(layer, jnp.int32)])
+    meta = jnp.stack([
+        jnp.asarray(n_extra, jnp.int32),
+        jnp.asarray(layer, jnp.int32),
+        jnp.asarray(0 if window is None else window, jnp.int32),
+    ])
 
-    kernel = functools.partial(_decode_kernel, ps=ps, scale=scale)
+    kernel = functools.partial(_decode_kernel, ps=ps, scale=scale,
+                               softcap=float(softcap or 0.0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B,),

@@ -44,7 +44,7 @@ NEG_INF = -1e30
 def _prefill_kernel(
     # scalar prefetch
     page_table_ref,  # [mp] int32 (SMEM)
-    meta_ref,  # [3] int32 (SMEM): [prefix_len, t_real, layer]
+    meta_ref,  # [4] int32 (SMEM): [prefix_len, t_real, layer, window]
     # inputs
     q_ref,  # [1, R, CD] VMEM — block-diagonal queries (R = T*C*G)
     ck_ref,  # [1, T, CD] VMEM — chunk keys (this program's lane slice)
@@ -64,6 +64,7 @@ def _prefill_kernel(
     ppb: int,
     cg: int,  # C*G: query rows per chunk token
     scale: float,
+    softcap: float,
 ):
     prog = pl.program_id(0)
     R = q_ref.shape[1]
@@ -74,9 +75,15 @@ def _prefill_kernel(
     prefix_len = meta_ref[0]
     t_real = meta_ref[1]
     layer = meta_ref[2]
+    window = meta_ref[3]
     lane0 = prog * CD
 
     n_blocks = (prefix_len + bt - 1) // bt
+    # sliding window: the EARLIEST query in the chunk sits at prefix_len, so
+    # prefix blocks wholly below ``prefix_len - window`` are skipped — the
+    # DMA loop starts at the first block any query can still see
+    lo_min = jnp.where(window > 0, jnp.maximum(prefix_len - window + 1, 0), 0)
+    start_block = jnp.minimum(lo_min // bt, n_blocks)
 
     def dma(i, g, slot):
         idx = jnp.minimum(i * ppb + g, mp - 1)
@@ -108,11 +115,16 @@ def _prefill_kernel(
     stat_ref[:, 0:128] = jnp.full((R, 128), NEG_INF, jnp.float32)
     stat_ref[:, 128:256] = jnp.zeros((R, 128), jnp.float32)
 
-    @pl.when(n_blocks > 0)
+    @pl.when(n_blocks > start_block)
     def _prologue():
-        start_dma(0, 0)
+        start_dma(start_block, jax.lax.rem(start_block, 2))
 
     q = q_ref[0].astype(jnp.float32)  # [R, CD]
+
+    def cap(scores):
+        if softcap:
+            return softcap * jnp.tanh(scores / softcap)
+        return scores
 
     def merge(scores, v_block):
         """Online-softmax merge of scores [R, S] with values [S, CD]."""
@@ -139,32 +151,39 @@ def _prefill_kernel(
         wait_dma(i, slot)
         k = k_buf[slot].astype(jnp.float32)  # [BT, CD]
         v = v_buf[slot].astype(jnp.float32)
-        scores = jax.lax.dot_general(
+        scores = cap(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [R, BT]
+        ) * scale)  # [R, BT]
         slot_pos = i * bt + jax.lax.broadcasted_iota(jnp.int32, (R, bt), 1)
-        scores = jnp.where(slot_pos < prefix_len, scores, NEG_INF)
+        keep = slot_pos < prefix_len
+        # per-row window cut: query row r sits at prefix_len + r//cg
+        qpos_row = prefix_len + jax.lax.broadcasted_iota(jnp.int32, (R, bt), 0) // cg
+        keep &= (window <= 0) | (slot_pos > qpos_row - window)
+        scores = jnp.where(keep, scores, NEG_INF)
         merge(scores, v)
         return 0
 
-    jax.lax.fori_loop(0, n_blocks, body, 0)
+    jax.lax.fori_loop(start_block, n_blocks, body, 0)
 
     # the chunk itself: causal, straight from VMEM
     ck = ck_ref[0].astype(jnp.float32)  # [T, CD]
     cv = cv_ref[0].astype(jnp.float32)
-    s_chunk = jax.lax.dot_general(
+    s_chunk = cap(jax.lax.dot_general(
         q, ck, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [R, T]
+    ) * scale)  # [R, T]
     t_row = jax.lax.broadcasted_iota(jnp.int32, (R, T), 0) // cg
     col = jax.lax.broadcasted_iota(jnp.int32, (R, T), 1)
-    s_chunk = jnp.where((col <= t_row) & (col < t_real), s_chunk, NEG_INF)
+    keep = (col <= t_row) & (col < t_real)
+    # both query and key sit at prefix_len + {t_row, col}: offsets cancel
+    keep &= (window <= 0) | (col > t_row - window)
+    s_chunk = jnp.where(keep, s_chunk, NEG_INF)
     merge(s_chunk, cv)
 
     l = stat_ref[:, 128:129]
     out_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "softcap", "interpret"))
 def paged_attention_prefill(
     q: jax.Array,  # [T, H, D] post-rope chunk queries
     chunk_k: jax.Array,  # [T, K*D] post-rope chunk keys (fused lanes)
@@ -176,6 +195,8 @@ def paged_attention_prefill(
     prefix_len,  # scalar int32: cached tokens before this chunk
     t_real,  # scalar int32: valid chunk rows
     scale: float,
+    softcap: float | None = None,  # tanh softcap on attn logits (Gemma-2)
+    window=None,  # scalar int32 sliding window (None/<=0 = global)
     interpret: bool = False,
 ) -> jax.Array:
     """Prefix-aware chunked-prefill attention for ONE sequence.
@@ -210,9 +231,11 @@ def paged_attention_prefill(
         jnp.asarray(prefix_len, jnp.int32),
         jnp.asarray(t_real, jnp.int32),
         jnp.asarray(layer, jnp.int32),
+        jnp.asarray(0 if window is None else window, jnp.int32),
     ])
 
-    kernel = functools.partial(_prefill_kernel, ps=ps, ppb=ppb, cg=C * G, scale=scale)
+    kernel = functools.partial(_prefill_kernel, ps=ps, ppb=ppb, cg=C * G,
+                               scale=scale, softcap=float(softcap or 0.0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(KC,),

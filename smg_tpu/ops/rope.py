@@ -66,10 +66,11 @@ def apply_mrope(
     position row.  Text tokens carry three equal ids, which makes this
     EXACTLY ``apply_rope`` for text-only sequences (the parity the engine
     relies on to keep text requests on the standard path).
-    x: [T, H, D].
+    x: [..., T, H, D]; positions: [..., 3, T] (leading dims broadcast with x,
+    e.g. the grouped-prefill batch).
     """
     import numpy as np
 
     sel = np.repeat(np.arange(3), np.asarray(section, np.int64))  # [D/2] static
-    pos_f = positions.astype(jnp.float32).T[:, sel]  # [T, D/2]
+    pos_f = jnp.moveaxis(positions.astype(jnp.float32), -2, -1)[..., sel]
     return _rotate_half(x, pos_f * inv_freq)

@@ -21,8 +21,10 @@ last hop and are psum-broadcast for the replicated unembed.
 State (KV cache / horizon side buffers) is kept only on the owning tick, so
 off-turn garbage compute never corrupts a stage's shard.
 
-Not composed with LoRA or the Pallas/ring attention variants in v1 — the
-runner forces the XLA attention path and rejects adapters under pp.
+LoRA banks (layer-stacked [L, N, ...]) shard over ``pp`` alongside the
+weights; M-RoPE rope ids/deltas ride the replicated consts.  The Pallas and
+ring attention variants still don't run inside the pp shard_map — the
+runner forces the XLA attention path under pp.
 """
 
 from __future__ import annotations
@@ -41,24 +43,30 @@ def pp_serving_scan(
     layers,                    # pytree, leading dim = L
     consts: tuple,             # replicated arrays the body closes over
     axis: str = "pp",
+    lora=None,                 # optional adapter bank, leading dim = L
 ):
     """Run ``make_body(*consts)``'s layer body over a pp-sharded stack.
 
     ``make_body(*consts) -> body`` where ``body((h, s1, s2), (layer, l))``
     is a standard ``lax.scan`` layer step; ``l`` is the LOCAL layer index
-    into the stage's state shard.  Returns (h, s1, s2) with ``h``
-    replicated and state still sharded.
+    into the stage's state shard.  With ``lora`` the xs triple becomes
+    ``(layer, lora_layer, l)`` — the bank shards its layer axis over ``pp``
+    exactly like the weights.  Returns (h, s1, s2) with ``h`` replicated
+    and state still sharded.
     """
     S = mesh.shape[axis]
     L = jax.tree.leaves(layers)[0].shape[0]
     if L % S != 0:
         raise ValueError(f"num_layers {L} not divisible by pp={S}")
 
-    def run(h, s1, s2, layers_local, consts):
+    def run(h, s1, s2, layers_local, lora_local, consts):
+        from smg_tpu.models.llama import _scan_xs
+
         body = make_body(*consts)
         L_local = jax.tree.leaves(layers_local)[0].shape[0]
         stage = jax.lax.axis_index(axis)
-        xs = (layers_local, jnp.arange(L_local))
+        xs = _scan_xs(layers_local, lora_local if lora is not None else None,
+                      L_local)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, s):
@@ -77,16 +85,17 @@ def pp_serving_scan(
         return h, s1, s2
 
     layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    lora_specs = jax.tree.map(lambda _: P(axis), lora)
     const_specs = jax.tree.map(lambda _: P(), consts)
     fn = jax.shard_map(
         run,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), layer_specs, const_specs),
+        in_specs=(P(), P(axis), P(axis), layer_specs, lora_specs, const_specs),
         out_specs=(P(), P(axis), P(axis)),
         axis_names={axis},
         check_vma=False,
     )
-    return fn(h, s1, s2, layers, consts)
+    return fn(h, s1, s2, layers, lora, consts)
 
 
 def pp_decode_scan(
@@ -98,6 +107,7 @@ def pp_decode_scan(
     layers,
     consts: tuple,
     axis: str = "pp",
+    lora=None,                 # optional adapter bank, leading dim = L
 ):
     """Decode-horizon variant of :func:`pp_serving_scan`: the frozen KV
     cache enters each stage as a LOCAL read-only shard (it is already
@@ -108,11 +118,14 @@ def pp_decode_scan(
     if L % S != 0:
         raise ValueError(f"num_layers {L} not divisible by pp={S}")
 
-    def run(h, hk, hv, kc, vc, layers_local, consts):
+    def run(h, hk, hv, kc, vc, layers_local, lora_local, consts):
+        from smg_tpu.models.llama import _scan_xs
+
         body = make_body(*consts, kc, vc)
         L_local = jax.tree.leaves(layers_local)[0].shape[0]
         stage = jax.lax.axis_index(axis)
-        xs = (layers_local, jnp.arange(L_local))
+        xs = _scan_xs(layers_local, lora_local if lora is not None else None,
+                      L_local)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, s):
@@ -130,14 +143,15 @@ def pp_decode_scan(
         return h, hk, hv
 
     layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    lora_specs = jax.tree.map(lambda _: P(axis), lora)
     const_specs = jax.tree.map(lambda _: P(), consts)
     fn = jax.shard_map(
         run,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis), P(axis), layer_specs,
-                  const_specs),
+                  lora_specs, const_specs),
         out_specs=(P(), P(axis), P(axis)),
         axis_names={axis},
         check_vma=False,
     )
-    return fn(h, hk, hv, k_cache, v_cache, layers, consts)
+    return fn(h, hk, hv, k_cache, v_cache, layers, lora, consts)

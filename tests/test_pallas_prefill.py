@@ -43,12 +43,14 @@ def _setup(T, H, D, K, ps, mp, prefix_len, t_real, P=64, seed=0):
     return q, ck, cv, k_cache, v_cache, layer, page_table
 
 
-def _xla_reference(q, k_cache, v_cache, layer, page_table, prefix_len, t_real, K):
+def _xla_reference(q, k_cache, v_cache, layer, page_table, prefix_len, t_real, K,
+                   softcap=None, window=None):
     T, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
     k_ctx, v_ctx = gather_seq_kv(k_cache[layer], v_cache[layer], page_table, K)
     pos = prefix_len + jnp.arange(T)
-    return attention_prefill(q, k_ctx, v_ctx, pos, jnp.int32(prefix_len + t_real), scale)
+    return attention_prefill(q, k_ctx, v_ctx, pos, jnp.int32(prefix_len + t_real),
+                             scale, softcap=softcap, window=window)
 
 
 @pytest.mark.parametrize(
@@ -77,6 +79,66 @@ def test_parity_vs_xla(T, H, D, K, prefix_len, t_real):
     np.testing.assert_allclose(
         np.asarray(got[:t_real]), np.asarray(want[:t_real]), rtol=2e-5, atol=2e-5
     )
+
+
+@pytest.mark.parametrize(
+    "softcap,window",
+    [
+        (30.0, None),   # Gemma-2 softcap only
+        (None, 100),    # window cuts into the prefix (prefix 160)
+        (None, 8),      # window smaller than the chunk: cuts intra-chunk too
+        (30.0, 100),    # both together (Gemma-2 local layers)
+        (None, 4096),   # window wider than everything = global
+        (None, 0),      # window<=0 means global
+    ],
+)
+def test_parity_softcap_window(softcap, window):
+    """Sliding-window + logit-softcap masks in the pallas prefill kernel
+    match the XLA path (VERDICT r4 next-round #1)."""
+    T, H, D, K, prefix_len, t_real = 16, 8, 64, 8, 160, 16
+    ps, mp = 16, 24
+    q, ck, cv, k_cache, v_cache, layer, page_table = _setup(
+        T, H, D, K, ps, mp, prefix_len, t_real
+    )
+    scale = 1.0 / np.sqrt(D)
+    w = None if window is None else jnp.int32(window)
+    got = paged_attention_prefill(
+        q, ck, cv, k_cache, v_cache, layer, page_table,
+        prefix_len, t_real, scale, softcap=softcap, window=w, interpret=True,
+    )
+    want = _xla_reference(q, k_cache, v_cache, layer, page_table,
+                          prefix_len, t_real, K, softcap=softcap, window=w)
+    np.testing.assert_allclose(
+        np.asarray(got[:t_real]), np.asarray(want[:t_real]), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_window_skips_out_of_window_prefix_blocks():
+    """Prefix blocks wholly below every query's window must never be read:
+    poison them with NaN and require a finite, XLA-matching result."""
+    T, H, D, K, ps = 16, 8, 64, 8, 16
+    mp, P = 40, 96
+    prefix_len, t_real = 37 * 16 + 5, 16  # 597 tokens
+    window = 64  # earliest query at 597: window floor 534 → blocks 0-3 dead
+    q, ck, cv, k_cache, v_cache, layer, page_table = _setup(
+        T, H, D, K, ps, mp, prefix_len, t_real, P=P
+    )
+    want = _xla_reference(q, k_cache, v_cache, layer, page_table,
+                          prefix_len, t_real, K, window=jnp.int32(window))
+    # poison pages holding positions < 512 (first 4 of 5 128-token blocks)
+    pt = np.asarray(page_table)
+    kc, vc = np.array(k_cache), np.array(v_cache)
+    for i in range(32):
+        kc[layer, pt[i]] = np.nan
+        vc[layer, pt[i]] = np.nan
+    scale = 1.0 / np.sqrt(D)
+    got = paged_attention_prefill(
+        q, ck, cv, jnp.asarray(kc), jnp.asarray(vc), layer, page_table,
+        prefix_len, t_real, scale, window=jnp.int32(window), interpret=True,
+    )
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_long_prefix_multiblock():

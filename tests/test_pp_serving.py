@@ -95,10 +95,79 @@ def test_pp_composes_with_tp(cpu_devices):
     assert got.token_ids == want.token_ids
 
 
-def test_pp_rejects_lora(cpu_devices):
-    eng = _engine(ParallelConfig(pp=2), cpu_devices[:2])
+def test_pp_lora_matches_single(cpu_devices):
+    """LoRA under serving pp (r5: the bank shards its layer axis over pp
+    like the weights) — token-exact vs the single-device adapted run."""
+    from tests.test_lora import strong_adapter
+
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=8,
+                              ignore_eos=True, lora_adapter="s")
+    prompt = [(i * 5) % 90 + 7 for i in range(30)]
+    single = _engine(ParallelConfig(), cpu_devices[:1])
     try:
-        with pytest.raises(ValueError, match="serving pp"):
-            eng.runner.load_lora("a", {})
+        single.runner.load_lora("s", strong_adapter(single.config.model))
+        want = single.generate(prompt_ids=prompt, sampling=sampling)
     finally:
-        eng.stop()
+        single.stop()
+    pp_eng = _engine(ParallelConfig(pp=2), cpu_devices[:2])
+    try:
+        pp_eng.runner.load_lora("s", strong_adapter(pp_eng.config.model))
+        got = pp_eng.generate(prompt_ids=prompt, sampling=sampling)
+    finally:
+        pp_eng.stop()
+    assert got.token_ids == want.token_ids
+
+
+def test_pp_mrope_matches_single(cpu_devices):
+    """M-RoPE requests under serving pp (r5: rope ids/deltas ride the pp
+    consts) — token-exact vs single device."""
+    from smg_tpu.models.config import tiny_vlm_mrope_config
+
+    def _vlm_engine(parallel, devs):
+        cfg = EngineConfig(
+            model=tiny_vlm_mrope_config(),
+            parallel=parallel,
+            cache=CacheConfig(page_size=16, num_pages=96, auto_size=False,
+                              dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=4, max_seq_len=256, max_prefill_tokens=64,
+                prefill_token_buckets=(32, 64), decode_batch_buckets=(4,),
+            ),
+            dtype="float32", model_id="tiny-mrope",
+        )
+        return Engine(cfg, tokenizer=MockTokenizer(), devices=devs)
+
+    def run(eng):
+        table = np.asarray(
+            np.array(eng.runner.params["embed"], np.float32))
+        pad = eng.config.model.image_token_id
+        prompt = [5, 6, pad, pad, pad, pad, 9, 10, 11, 12]
+        mm = (table[[42, 43, 44, 45]], np.asarray([2, 3, 4, 5]), [(2, 2)])
+        out = {}
+
+        def cb(o):
+            out.setdefault("r", []).append(o)
+
+        eng.submit(prompt, SamplingParams(temperature=0.0, max_new_tokens=8,
+                                          ignore_eos=True),
+                   on_output=cb, mm_embeds=mm)
+        for _ in range(300):
+            eng.step()
+            if out.get("r") and out["r"][-1].finished:
+                break
+        return [t for o in out["r"] for t in o.new_token_ids]
+
+    nl = tiny_vlm_mrope_config().num_layers
+    if nl % 2:
+        pytest.skip(f"{nl} layers not divisible by pp=2")
+    single = _vlm_engine(ParallelConfig(), cpu_devices[:1])
+    try:
+        want = run(single)
+    finally:
+        single.stop()
+    pp_eng = _vlm_engine(ParallelConfig(pp=2), cpu_devices[:2])
+    try:
+        got = run(pp_eng)
+    finally:
+        pp_eng.stop()
+    assert got == want and len(got) == 8

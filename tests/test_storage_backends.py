@@ -317,6 +317,10 @@ class FakePg:
         await writer.drain()
 
     def _nextval(self, m: re.Match) -> str:
+        # value position only: a nextval('x') INSIDE a quoted string literal
+        # (odd number of preceding quotes) is stored content, not SQL
+        if m.string.count("'", 0, m.start()) % 2 == 1:
+            return m.group(0)
         name = m.group(1)
         self.seqs[name] = self.seqs.get(name, 0) + 1
         return str(self.seqs[name])
