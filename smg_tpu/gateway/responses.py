@@ -182,6 +182,17 @@ class ResponsesHandler:
             usage.input_tokens += resp.usage.prompt_tokens
             usage.output_tokens += resp.usage.completion_tokens
 
+            if getattr(choice.message, "reasoning_content", None):
+                # harmony analysis channel (and any reasoning-parser model)
+                # surfaces as a reasoning output item (Responses API shape)
+                output_items.append({
+                    "type": "reasoning",
+                    "summary": [],
+                    "content": [{
+                        "type": "reasoning_text",
+                        "text": choice.message.reasoning_content,
+                    }],
+                })
             if choice.message.content:
                 output_items.append(
                     ResponseMessageItem(
@@ -303,6 +314,13 @@ class ResponsesHandler:
                     if c.get("type") == "output_text" and c.get("text"):
                         yield ev(
                             "response.output_text.delta",
+                            {"output_index": idx, "delta": c["text"]},
+                        )
+            elif item.get("type") == "reasoning":
+                for c in item.get("content", []):
+                    if c.get("type") == "reasoning_text" and c.get("text"):
+                        yield ev(
+                            "response.reasoning_text.delta",
                             {"output_index": idx, "delta": c["text"]},
                         )
             yield ev("response.output_item.done", {"output_index": idx, "item": item})

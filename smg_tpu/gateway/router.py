@@ -62,6 +62,9 @@ class RouterConfig:
     # parser selection: None = auto by model name; "passthrough" disables
     reasoning_parser: str | None = None
     tool_parser: str | None = None
+    # harmony (gpt-oss) pipeline: None = auto-detect by model name; True/False
+    # force (reference: harmony/detector.rs + pipeline.rs:1073-1191)
+    harmony: bool | None = None
     # DP-rank stage for dp_size>1 workers: "dp_min_token" pins each request to
     # the replica with the fewest outstanding tokens; "dp_passthrough" lets
     # the worker balance locally (reference: dp_min_token.rs:24-31)
@@ -490,12 +493,38 @@ class Router:
 
     # ---- chat completions ----
 
+    def _is_harmony(self, model: str | None) -> bool:
+        if self.config.harmony is not None:
+            return self.config.harmony
+        from smg_tpu.gateway.harmony import is_harmony_model
+
+        return is_harmony_model(model)
+
     def _prepare_chat(self, req: ChatCompletionRequest):
         tokenizer = self.tokenizers.get(req.model or None)
         if tokenizer is None:
             raise RouteError(500, "no tokenizer registered for gateway-side processing")
         messages = [m.model_dump(exclude_none=True) for m in req.messages]
         tools = [t.model_dump(exclude_none=True) for t in req.tools] if req.tools else None
+        if self._is_harmony(req.model):
+            # harmony models bypass the HF chat template: the gateway renders
+            # the channel-structured frame format itself and stops generation
+            # at end-of-response / end-of-tool-call markers
+            from smg_tpu.gateway.harmony import HARMONY_STOPS, render_harmony_prompt
+
+            prompt_text = render_harmony_prompt(
+                messages, tools=tools,
+                reasoning_effort=getattr(req, "reasoning_effort", None) or "medium",
+            )
+            input_ids = self.tokenizers.encode_cached(req.model or None, prompt_text)
+            sampling = req.to_sampling_params(self.config.default_max_tokens)
+            stops = list(sampling.stop or [])
+            sampling.stop = stops + [s for s in HARMONY_STOPS if s not in stops]
+            # the channel markers ARE special tokens on real gpt-oss
+            # tokenizers — skip_special_tokens would strip them before the
+            # demux and the gateway-side stop checker ever see them
+            sampling.skip_special_tokens = False
+            return tokenizer, prompt_text, input_ids, sampling
         try:
             prompt_text = tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True, tools=tools
@@ -529,6 +558,9 @@ class Router:
         parts = extract_image_parts(messages)
         if not parts:
             return (*self._prepare_chat(req), None)
+        if self._is_harmony(req.model):
+            # gpt-oss is text-only (reference builder rejects media content)
+            raise RouteError(400, "harmony (gpt-oss) models accept text input only")
 
         tokenizer = self.tokenizers.get(req.model or None)
         if tokenizer is None:
@@ -648,29 +680,46 @@ class Router:
             text = "".join(text_parts)
 
             reasoning_content = None
-            if req.separate_reasoning:
-                from smg_tpu.parsers import get_reasoning_parser
-
-                rp = get_reasoning_parser(self.config.reasoning_parser or req.model)
-                text, reasoning = rp.parse_full(text)
-                reasoning_content = reasoning or None
-
             tool_calls = None
             finish = last.finish_reason or "stop"
-            if req.tools:
-                from smg_tpu.parsers import get_tool_parser
+            if self._is_harmony(req.model):
+                # always demux: raw channel markup must never reach a client
+                from smg_tpu.gateway.harmony import HarmonyStreamingProcessor
 
-                tp = get_tool_parser(self.config.tool_parser or req.model)
-                text, parsed = tp.parse_full(text)
-                if parsed:
+                text, reasoning, calls = HarmonyStreamingProcessor().parse_full(text)
+                reasoning_content = (reasoning or None) if req.separate_reasoning else None
+                if calls:
                     tool_calls = [
                         ToolCall(
-                            id=c.id, index=c.index,
-                            function=FunctionCall(name=c.name, arguments=c.arguments),
+                            id=c["id"], index=i,
+                            function=FunctionCall(name=c["name"],
+                                                  arguments=c["arguments"]),
                         )
-                        for c in parsed
+                        for i, c in enumerate(calls)
                     ]
                     finish = "tool_calls"
+            else:
+                if req.separate_reasoning:
+                    from smg_tpu.parsers import get_reasoning_parser
+
+                    rp = get_reasoning_parser(self.config.reasoning_parser or req.model)
+                    text, reasoning = rp.parse_full(text)
+                    reasoning_content = reasoning or None
+
+                if req.tools:
+                    from smg_tpu.parsers import get_tool_parser
+
+                    tp = get_tool_parser(self.config.tool_parser or req.model)
+                    text, parsed = tp.parse_full(text)
+                    if parsed:
+                        tool_calls = [
+                            ToolCall(
+                                id=c.id, index=c.index,
+                                function=FunctionCall(name=c.name, arguments=c.arguments),
+                            )
+                            for c in parsed
+                        ]
+                        finish = "tool_calls"
 
             choice = ChatCompletionChoice(
                 index=choice_idx,
@@ -722,21 +771,49 @@ class Router:
             sub_rid = rid if sampling.n == 1 else f"{rid}-{idx}"
             one_sampling = SamplingParams(**{**sampling.__dict__, "n": 1})
             first = True
-            rp = tp = None
-            if req.separate_reasoning:
-                from smg_tpu.parsers import get_reasoning_parser
+            rp = tp = hp = None
+            if self._is_harmony(req.model):
+                from smg_tpu.gateway.harmony import HarmonyStreamingProcessor
 
-                rp = get_reasoning_parser(self.config.reasoning_parser or req.model)
-            if req.tools:
-                from smg_tpu.parsers import get_tool_parser
+                hp = HarmonyStreamingProcessor()
+            else:
+                if req.separate_reasoning:
+                    from smg_tpu.parsers import get_reasoning_parser
 
-                tp = get_tool_parser(self.config.tool_parser or req.model)
+                    rp = get_reasoning_parser(self.config.reasoning_parser or req.model)
+                if req.tools:
+                    from smg_tpu.parsers import get_tool_parser
+
+                    tp = get_tool_parser(self.config.tool_parser or req.model)
             saw_tool_calls = False
 
             def make_delta(text: str, flush: bool = False):
                 nonlocal saw_tool_calls
                 reasoning = None
                 calls = None
+                if hp is not None:
+                    # harmony channel demux: analysis -> reasoning deltas,
+                    # commentary tool frames -> INCREMENTAL argument deltas
+                    # (reference streaming.rs FunctionDelta fragments)
+                    d = hp.feed(text)
+                    if flush:
+                        df = hp.flush()
+                        d.analysis += df.analysis
+                        d.final += df.final
+                        d.tool_deltas.extend(df.tool_deltas)
+                    text = d.final
+                    reasoning = (d.analysis or None) if req.separate_reasoning else None
+                    if d.tool_deltas:
+                        saw_tool_calls = True
+                        calls = [
+                            ToolCall(
+                                id=td.id, index=td.index,
+                                function=FunctionCall(name=td.name,
+                                                      arguments=td.arguments),
+                            )
+                            for td in d.tool_deltas
+                        ]
+                    return text, reasoning, calls
                 if rp is not None:
                     d = rp.feed(text)
                     if flush:

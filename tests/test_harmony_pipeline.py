@@ -1,0 +1,370 @@
+"""Harmony (gpt-oss) serving pipeline (VERDICT r4 next-round #2): channel
+-structured prompt building, streaming channel demux with incremental tool
+-call argument deltas, and Responses-API integration — e2e through the
+router against a scripted worker (reference:
+``model_gateway/src/routers/grpc/harmony/{builder,streaming}.rs`` +
+``pipeline.rs:1073-1191``)."""
+
+import asyncio
+import json
+
+import pytest
+
+from smg_tpu.gateway.harmony import (
+    HarmonyStreamingProcessor,
+    build_system_message,
+    is_harmony_model,
+    render_harmony_prompt,
+    render_tool_namespace,
+)
+from smg_tpu.gateway.router import Router, RouterConfig
+from smg_tpu.gateway.worker_client import WorkerClient, WorkerStreamChunk
+from smg_tpu.gateway.workers import Worker, WorkerRegistry
+from smg_tpu.policies import PolicyRegistry
+from smg_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
+from smg_tpu.tokenizer.registry import TokenizerRegistry
+
+WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the weather",
+        "parameters": {
+            "type": "object",
+            "properties": {
+                "city": {"type": "string", "description": "The city"},
+                "unit": {"type": "string", "enum": ["c", "f"]},
+            },
+            "required": ["city"],
+        },
+    },
+}
+
+
+# ---- detector ----
+
+
+def test_detector():
+    assert is_harmony_model("gpt-oss-120b")
+    assert is_harmony_model("openai/GPT-OSS-20b")
+    assert is_harmony_model("gpt_oss_tiny")
+    assert not is_harmony_model("llama-3-8b")
+    assert not is_harmony_model(None)
+
+
+# ---- builder ----
+
+
+def test_system_message_channels_depend_on_tools():
+    no_tools = build_system_message(has_tools=False, current_date="2026-07-30")
+    with_tools = build_system_message(has_tools=True, current_date="2026-07-30")
+    assert "# Valid channels: analysis, final." in no_tools
+    assert "commentary" not in no_tools
+    assert "# Valid channels: analysis, commentary, final." in with_tools
+    assert "commentary channel: 'functions'" in with_tools
+    assert "Current date: 2026-07-30" in with_tools
+    assert "Reasoning: medium" in with_tools
+
+
+def test_tool_namespace_typescript_rendering():
+    ns = render_tool_namespace([WEATHER_TOOL])
+    assert "namespace functions {" in ns
+    assert "// Get the weather" in ns
+    assert "type get_weather = (_: {" in ns
+    assert "// The city" in ns
+    assert "city: string," in ns
+    assert 'unit?: "c" | "f",' in ns
+    assert ns.rstrip().endswith("} // namespace functions")
+
+
+def test_render_prompt_full_history():
+    messages = [
+        {"role": "system", "content": "Be terse."},
+        {"role": "user", "content": "weather in Paris?"},
+        {"role": "assistant", "content": None, "tool_calls": [{
+            "id": "call_0", "type": "function",
+            "function": {"name": "get_weather", "arguments": '{"city": "Paris"}'},
+        }]},
+        {"role": "tool", "tool_call_id": "call_0", "content": "18C sunny"},
+    ]
+    p = render_harmony_prompt(messages, tools=[WEATHER_TOOL],
+                              current_date="2026-07-30")
+    # system frame: the fixed channel contract, NOT the user system prompt
+    assert p.startswith("<|start|>system<|message|>You are ChatGPT")
+    # user system prompt lands in the developer instructions
+    assert "<|start|>developer<|message|># Instructions\n\nBe terse." in p
+    assert "namespace functions {" in p
+    assert "<|start|>user<|message|>weather in Paris?<|end|>" in p
+    # prior tool call re-renders as a commentary frame
+    assert ("<|start|>assistant<|channel|>commentary to=functions.get_weather "
+            '<|constrain|>json<|message|>{"city": "Paris"}<|call|>') in p
+    # tool result frames as functions.NAME to=assistant
+    assert ("<|start|>functions.get_weather to=assistant<|channel|>commentary"
+            "<|message|>18C sunny<|end|>") in p
+    assert p.endswith("<|start|>assistant")
+
+
+# ---- streaming demux ----
+
+
+FRAME_TEXT = (
+    "<|channel|>analysis<|message|>user wants weather<|end|>"
+    "<|start|>assistant<|channel|>commentary<|message|>Let me check.<|end|>"
+    "<|start|>assistant<|channel|>commentary to=functions.get_weather "
+    '<|constrain|>json<|message|>{"city": "Paris"}<|call|>'
+    "<|start|>assistant<|channel|>final<|message|>It is sunny.<|return|>"
+)
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 7, len(FRAME_TEXT)])
+def test_streaming_demux_any_chunking(chunk):
+    hp = HarmonyStreamingProcessor()
+    analysis = final = args = ""
+    names = []
+    for i in range(0, len(FRAME_TEXT), chunk):
+        d = hp.feed(FRAME_TEXT[i : i + chunk])
+        analysis += d.analysis
+        final += d.final
+        for td in d.tool_deltas:
+            if td.name:
+                names.append((td.index, td.name, td.id))
+            if td.arguments:
+                args += td.arguments
+    d = hp.flush()
+    analysis += d.analysis
+    final += d.final
+    assert analysis == "user wants weather"
+    # plain commentary (preamble) is user-visible, like the final channel
+    assert final == "Let me check.It is sunny."
+    assert names == [(0, "get_weather", "call_0")]
+    assert json.loads(args) == {"city": "Paris"}
+
+
+def test_streaming_incremental_args():
+    """Argument fragments stream as they arrive — not one blob at the end."""
+    hp = HarmonyStreamingProcessor()
+    head = '<|channel|>commentary to=functions.f<|message|>{"x": '
+    d1 = hp.feed(head)
+    assert [td.name for td in d1.tool_deltas if td.name] == ["f"]
+    frag1 = "".join(td.arguments or "" for td in d1.tool_deltas)
+    d2 = hp.feed("1234")
+    frag2 = "".join(td.arguments or "" for td in d2.tool_deltas)
+    assert frag2  # args flowed before the frame closed
+    d3 = hp.feed("}<|call|>")
+    frag3 = "".join(td.arguments or "" for td in d3.tool_deltas)
+    assert json.loads(frag1 + frag2 + frag3) == {"x": 1234}
+
+
+def test_parse_full():
+    content, reasoning, calls = HarmonyStreamingProcessor().parse_full(FRAME_TEXT)
+    assert reasoning == "user wants weather"
+    assert content == "Let me check.It is sunny."
+    assert len(calls) == 1
+    assert calls[0]["name"] == "get_weather"
+    assert json.loads(calls[0]["arguments"]) == {"city": "Paris"}
+
+
+def test_parse_full_unterminated_tool_frame():
+    """Stop-string handling eats <|call|> server-side; flush still closes."""
+    text = ('<|channel|>analysis<|message|>hm<|end|>'
+            '<|start|>assistant<|channel|>commentary to=functions.f'
+            '<|message|>{"a": 1}')
+    content, reasoning, calls = HarmonyStreamingProcessor().parse_full(text)
+    assert reasoning == "hm"
+    assert calls[0]["name"] == "f"
+    assert json.loads(calls[0]["arguments"]) == {"a": 1}
+
+
+# ---- router e2e against a scripted worker ----
+
+
+class CharTokenizer:
+    """Round-trips text as code points — lets scripted harmony text survive
+    the gateway's real tokenize/detokenize path."""
+
+    eos_token_id = 0
+    special_ids: set = set()
+
+    def encode(self, text: str, add_special_tokens: bool = False):
+        return [ord(c) for c in text]
+
+    def decode(self, ids, skip_special_tokens: bool = True):
+        return "".join(chr(i) for i in ids)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, **_):
+        raise AssertionError("harmony path must not hit the chat template")
+
+
+class ScriptedWorker(WorkerClient):
+    """Streams a scripted completion, a few tokens per chunk; captures the
+    prompt it was sent for builder assertions."""
+
+    def __init__(self, script: str, chunk: int = 5):
+        self.script = script
+        self.chunk = chunk
+        self.seen_input_ids = None
+        self.seen_sampling = None
+
+    async def generate(self, req):
+        self.seen_input_ids = list(req.input_ids)
+        self.seen_sampling = req.sampling
+        ids = [ord(c) for c in self.script]
+        n = max(1, self.chunk)
+        for i in range(0, len(ids), n):
+            last = i + n >= len(ids)
+            yield WorkerStreamChunk(
+                rid=req.rid,
+                token_ids=ids[i : i + n],
+                logprobs=[0.0] * len(ids[i : i + n]),
+                finished=last,
+                finish_reason="stop" if last else None,
+                prompt_tokens=len(self.seen_input_ids),
+                output_tokens=min(i + n, len(ids)),
+            )
+
+    async def abort(self, rid):
+        return True
+
+
+def _router(script: str):
+    registry = WorkerRegistry()
+    worker = ScriptedWorker(script)
+    registry.add(Worker(worker_id="w0", client=worker, model_id="gpt-oss-tiny"))
+    tokenizers = TokenizerRegistry()
+    tokenizers.register("gpt-oss-tiny", CharTokenizer(), default=True)
+    router = Router(registry, PolicyRegistry(default="round_robin"),
+                    tokenizers, RouterConfig())
+    return router, worker
+
+
+def test_router_chat_harmony_tool_call():
+    script = (
+        "<|channel|>analysis<|message|>need the weather<|end|>"
+        "<|start|>assistant<|channel|>commentary to=functions.get_weather "
+        '<|constrain|>json<|message|>{"city": "Paris"}<|call|>'
+        "LEAKED TEXT PAST THE CALL STOP"  # gateway stop checker must cut this
+    )
+    router, worker = _router(script)
+    req = ChatCompletionRequest(
+        model="gpt-oss-tiny",
+        messages=[ChatMessage(role="system", content="Be terse."),
+                  ChatMessage(role="user", content="weather in Paris?")],
+        tools=[WEATHER_TOOL],
+    )
+    resp = asyncio.run(router.chat(req))
+    msg = resp.choices[0].message
+    assert msg.reasoning_content == "need the weather"
+    assert resp.choices[0].finish_reason == "tool_calls"
+    assert msg.tool_calls[0].function.name == "get_weather"
+    assert json.loads(msg.tool_calls[0].function.arguments) == {"city": "Paris"}
+    assert not (msg.content or "")  # no channel markup leaks
+    # the prompt the worker saw was harmony-rendered, not chat-templated
+    prompt = "".join(chr(i) for i in worker.seen_input_ids)
+    assert prompt.startswith("<|start|>system<|message|>You are ChatGPT")
+    assert "namespace functions {" in prompt
+    assert prompt.endswith("<|start|>assistant")
+    # stop strings are enforced GATEWAY-side: the worker deliberately sees
+    # none, and the text the script emitted past <|call|> never surfaced
+    assert worker.seen_sampling.stop == []
+
+
+def test_router_chat_stream_harmony_deltas():
+    script = (
+        "<|channel|>analysis<|message|>thinking...<|end|>"
+        "<|start|>assistant<|channel|>final<|message|>Hello there!<|return|>"
+    )
+    router, _ = _router(script)
+    req = ChatCompletionRequest(
+        model="gpt-oss-tiny", stream=True,
+        messages=[ChatMessage(role="user", content="hi")],
+    )
+
+    async def collect():
+        reasoning = content = ""
+        finish = None
+        async for chunk in router.chat_stream(req):
+            d = chunk.choices[0].delta
+            reasoning += d.reasoning_content or ""
+            content += d.content or ""
+            finish = chunk.choices[0].finish_reason or finish
+        return reasoning, content, finish
+
+    reasoning, content, finish = asyncio.run(collect())
+    assert reasoning == "thinking..."
+    assert content == "Hello there!"
+    assert finish == "stop"
+
+
+def test_router_chat_stream_harmony_tool_arg_deltas():
+    script = (
+        "<|channel|>commentary to=functions.get_weather <|constrain|>json"
+        '<|message|>{"city": "Paris", "unit": "c"}<|call|>'
+    )
+    router, _ = _router(script)
+    req = ChatCompletionRequest(
+        model="gpt-oss-tiny", stream=True,
+        messages=[ChatMessage(role="user", content="weather?")],
+        tools=[WEATHER_TOOL],
+    )
+
+    async def collect():
+        opens, frags, finish = [], [], None
+        async for chunk in router.chat_stream(req):
+            c = chunk.choices[0]
+            for tc in c.delta.tool_calls or []:
+                if tc.function.name:
+                    opens.append((tc.index, tc.function.name, tc.id))
+                if tc.function.arguments:
+                    frags.append(tc.function.arguments)
+            finish = c.finish_reason or finish
+        return opens, frags, finish
+
+    opens, frags, finish = asyncio.run(collect())
+    assert opens == [(0, "get_weather", "call_0")]
+    assert len(frags) > 1, "arguments must stream incrementally"
+    assert json.loads("".join(frags)) == {"city": "Paris", "unit": "c"}
+    assert finish == "tool_calls"
+
+
+def test_harmony_content_parts_flatten():
+    """OpenAI content-parts arrays must flatten to text, not leak reprs."""
+    p = render_harmony_prompt(
+        [{"role": "user",
+          "content": [{"type": "text", "text": "hello "},
+                      {"type": "text", "text": "world"}]}],
+        current_date="2026-07-30",
+    )
+    assert "<|start|>user<|message|>hello world<|end|>" in p
+    assert "{'type'" not in p
+
+
+def test_harmony_disables_skip_special_tokens():
+    """Real gpt-oss tokenizers mark channel tokens special — the demux dies
+    if the detokenizer strips them."""
+    router, worker = _router("<|channel|>final<|message|>ok<|return|>")
+    req = ChatCompletionRequest(
+        model="gpt-oss-tiny",
+        messages=[ChatMessage(role="user", content="hi")],
+    )
+    asyncio.run(router.chat(req))
+    assert worker.seen_sampling.skip_special_tokens is False
+
+
+def test_responses_harmony_reasoning_item():
+    """Responses API on a harmony model: analysis surfaces as a reasoning
+    output item ahead of the message item."""
+    from smg_tpu.gateway.responses import ResponsesHandler
+    from smg_tpu.protocols.responses import ResponsesRequest
+
+    script = (
+        "<|channel|>analysis<|message|>pondering<|end|>"
+        "<|start|>assistant<|channel|>final<|message|>Done.<|return|>"
+    )
+    router, _ = _router(script)
+    handler = ResponsesHandler(router)
+    req = ResponsesRequest(model="gpt-oss-tiny", input="do the thing", store=False)
+    resp = asyncio.run(handler.create(req))
+    kinds = [o["type"] for o in resp.output]
+    assert kinds == ["reasoning", "message"]
+    assert resp.output[0]["content"][0]["text"] == "pondering"
+    assert resp.output[1]["content"][0]["text"] == "Done."
