@@ -898,14 +898,17 @@ async def h_responses_create(request: web.Request) -> web.Response | web.StreamR
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
+    tenant = request.get("tenant")
     async with ctx.semaphore:
         if not req.stream:
-            resp = await ctx.responses.create(req, request_id=rid)
+            resp = await ctx.responses.create(req, request_id=rid, tenant=tenant)
             return web.json_response(resp.model_dump(exclude_none=True))
         sse = _sse_response(request)
         await sse.prepare(request)
         try:
-            async for name, payload in ctx.responses.create_stream(req, request_id=rid):
+            async for name, payload in ctx.responses.create_stream(
+                req, request_id=rid, tenant=tenant
+            ):
                 await sse.write(f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode())
         except RouteError as e:
             err = {"type": "error", "error": {"message": e.message, "type": e.err_type}}

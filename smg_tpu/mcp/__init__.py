@@ -1,6 +1,15 @@
 """MCP client orchestration (reference: ``crates/mcp`` smg-mcp, SURVEY.md §2.2):
-server inventory, sessions, tool execution with approval flow."""
+server inventory, sessions, tool execution with approval flow, tenancy, and
+a typed error taxonomy."""
 
+from smg_tpu.mcp.approval import (
+    ApprovalManager,
+    ApprovalPolicy,
+    AuditLog,
+    Decision,
+    PolicyRule,
+    TrustLevel,
+)
 from smg_tpu.mcp.client import (
     HttpMcpServer,
     LocalToolServer,
@@ -8,6 +17,20 @@ from smg_tpu.mcp.client import (
     McpToolServer,
     ToolInfo,
 )
+from smg_tpu.mcp.errors import (
+    ApprovalDeniedError,
+    ApprovalNotFound,
+    ApprovalRequired,
+    McpError,
+    ServerAccessDenied,
+    ServerNotFound,
+    ToolCollision,
+    ToolDenied,
+    ToolExecutionError,
+    ToolNotFound,
+)
+from smg_tpu.mcp.inventory import McpInventory
+from smg_tpu.mcp.sessions import McpSession, SessionManager
 
 __all__ = [
     "McpToolServer",
@@ -15,4 +38,23 @@ __all__ = [
     "HttpMcpServer",
     "McpRegistry",
     "ToolInfo",
+    "McpInventory",
+    "McpSession",
+    "SessionManager",
+    "ApprovalManager",
+    "ApprovalPolicy",
+    "AuditLog",
+    "Decision",
+    "PolicyRule",
+    "TrustLevel",
+    "McpError",
+    "ServerNotFound",
+    "ServerAccessDenied",
+    "ToolNotFound",
+    "ToolCollision",
+    "ToolDenied",
+    "ToolExecutionError",
+    "ApprovalRequired",
+    "ApprovalDeniedError",
+    "ApprovalNotFound",
 ]

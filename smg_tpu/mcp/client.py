@@ -144,12 +144,17 @@ class HttpMcpServer(McpToolServer):
 
 
 class McpRegistry:
-    """Named MCP servers; flat tool namespace with a cached name->server map
-    (refreshed on registry change or lookup miss, not per call)."""
+    """Named MCP servers; flat tool namespace with a cached name->servers
+    map (refreshed on registry change or lookup miss, not per call).
+
+    Multi-server routing (reference: ``crates/mcp`` inventory index): a tool
+    name owned by several servers is a COLLISION — unqualified calls raise
+    :class:`~smg_tpu.mcp.errors.ToolCollision` and callers disambiguate
+    with the qualified ``server.tool`` form, which always works."""
 
     def __init__(self):
         self._servers: dict[str, McpToolServer] = {}
-        self._tool_map: dict[str, str] | None = None  # tool name -> server name
+        self._tool_map: dict[str, list[str]] | None = None  # tool -> servers
 
     def add(self, server: McpToolServer) -> None:
         self._servers[server.name] = server
@@ -165,7 +170,7 @@ class McpRegistry:
 
     async def list_tools(self) -> list[ToolInfo]:
         out: list[ToolInfo] = []
-        tool_map: dict[str, str] = {}
+        tool_map: dict[str, list[str]] = {}
         for s in self._servers.values():
             try:
                 tools = await s.list_tools()
@@ -173,18 +178,53 @@ class McpRegistry:
                 logger.exception("tools/list failed for MCP server %s", s.name)
                 continue
             for t in tools:
-                tool_map.setdefault(t.name, s.name)
+                tool_map.setdefault(t.name, []).append(s.name)
             out.extend(tools)
         self._tool_map = tool_map
         return out
 
+    async def collisions(self) -> dict[str, list[str]]:
+        """Tool names exported by more than one server."""
+        if self._tool_map is None:
+            await self.list_tools()
+        return {t: s for t, s in (self._tool_map or {}).items() if len(s) > 1}
+
+    def _resolve_qualified(self, name: str) -> "tuple[str, str] | None":
+        """``server.tool`` -> (server, tool) when the server exists."""
+        if "." in name:
+            server, _, tool = name.partition(".")
+            if server in self._servers:
+                return server, tool
+        return None
+
     async def call_tool(self, name: str, arguments: dict) -> str:
-        if self._tool_map is None or name not in self._tool_map:
-            await self.list_tools()  # refresh once on miss / first use
-        server_name = (self._tool_map or {}).get(name)
-        if server_name is None or server_name not in self._servers:
-            raise KeyError(f"tool {name!r} not found in any MCP server")
-        return await self._servers[server_name].call_tool(name, arguments)
+        from smg_tpu.mcp.errors import (
+            McpError,
+            ToolCollision,
+            ToolExecutionError,
+            ToolNotFound,
+        )
+
+        qualified = self._resolve_qualified(name)
+        if qualified is not None:
+            server_name, tool = qualified
+        else:
+            if self._tool_map is None or name not in self._tool_map:
+                await self.list_tools()  # refresh once on miss / first use
+            owners = (self._tool_map or {}).get(name) or []
+            if not owners:
+                raise ToolNotFound(f"tool {name!r} not found in any MCP server")
+            if len(owners) > 1:
+                raise ToolCollision(name, owners)
+            server_name, tool = owners[0], name
+        if server_name not in self._servers:
+            raise ToolNotFound(f"tool {name!r} not found in any MCP server")
+        try:
+            return await self._servers[server_name].call_tool(tool, arguments)
+        except McpError:
+            raise
+        except Exception as e:
+            raise ToolExecutionError(f"{tool!r} on {server_name!r}: {e}") from e
 
     async def close(self) -> None:
         for s in self._servers.values():

@@ -68,7 +68,9 @@ def test_local_mcp_server_and_registry():
         assert tools[0].name == "add"
         result = await reg.call_tool("add", {"a": 2, "b": 3})
         assert '"sum": 5' in result
-        with pytest.raises(KeyError):
+        from smg_tpu.mcp import ToolNotFound
+
+        with pytest.raises(ToolNotFound):
             await reg.call_tool("nope", {})
 
     asyncio.run(go())
