@@ -131,6 +131,10 @@ class EngineConfig:
     # LoRA adapter bank size (slots beyond the implicit "no adapter" slot 0;
     # reference: Load/Unload/ListLoRAAdapter, sglang_scheduler.proto:48-62)
     max_loras: int = 4
+    # speculative draft model (engine/draft.py): a smaller ModelConfig whose
+    # greedy proposals replace n-gram lookup; None = prompt-lookup drafting
+    draft_model: "object" = None
+    draft_seed: int = 1
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

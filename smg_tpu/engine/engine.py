@@ -69,6 +69,22 @@ class Engine:
         self.events = KvEventPublisher()
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.scheduler = Scheduler(self.runner, config, event_sink=self.events.publish)
+        if config.draft_model is not None and self.runner.mesh is None:
+            from smg_tpu.engine.draft import DraftRunner
+
+            self.scheduler.draft = DraftRunner(
+                config.draft_model,
+                num_pages=self.runner.spec.num_pages,
+                page_size=self.runner.spec.page_size,
+                prefill_bucket=config.scheduler.prefill_bucket,
+                dtype=config.cache.dtype,  # draft cache follows the KV dtype
+                seed=config.draft_seed,
+                device=self.runner._device,
+                max_prefill_tokens=min(
+                    config.scheduler.max_prefill_tokens,
+                    max(config.scheduler.prefill_token_buckets),
+                ),
+            )
         # vision tower (VLM): jitted per grid shape, params device-resident.
         # ``vision_params`` comes from the checkpoint loader
         # (models.weights.load_vision_params); random-init is the test path.

@@ -68,6 +68,9 @@ class EngineRequest:
     # + the request's incremental n-gram index (engine/speculative.py)
     spec_cold: int = 0
     spec_index: Any = None
+    # draft-model proposer: committed tokens mirrored into the draft KV
+    # cache so far (engine/draft.py; reset on preemption)
+    draft_len: int = 0
 
     @property
     def prompt_len(self) -> int:

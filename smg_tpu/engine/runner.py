@@ -964,6 +964,91 @@ class ModelRunner:
         arg, self.k_cache, self.v_cache = fn(*args)
         return np.asarray(arg)[:t]
 
+    def _verify_sample_fn(self, T: int, mp: int, use_mrope: bool = False):
+        """Speculative verify for temperature > 0: the prefill-shaped
+        forward feeds [y0, drafts...] and the acceptance runs ON DEVICE via
+        rejection sampling (``engine/sampling.py::spec_accept_sample``) —
+        distribution-preserving, no full-vocab distributions shipped to
+        host."""
+        impl = self._prefill_impl_for(mp)
+        k = ("verify_sample", T, mp, impl, use_mrope)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+        pp_mesh = self.mesh if self.use_pp else None
+
+        def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc,
+                 page_table, key, temp, topk, topp, minp, proposals, k_real,
+                 *extra):
+            from smg_tpu.engine.sampling import spec_accept_sample
+
+            rope_pos = extra[0] if use_mrope else None
+            logits, kc, vc = module.forward_prefill(
+                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc,
+                page_table, attn_impl=impl, rope_pos=rope_pos,
+                pp_mesh=pp_mesh,
+                all_logits=True,
+            )
+            final, n_acc = spec_accept_sample(
+                logits, proposals, k_real, key, temp, topk, topp, minp
+            )
+            return final, n_acc, kc, vc
+
+        if self.mesh is not None:
+            r = self._replicated
+            in_sh = (self.param_shardings, r, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r, r, r)
+            in_sh = in_sh + ((r,) if use_mrope else ())
+            fn = jax.jit(step, in_shardings=in_sh,
+                         out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
+                         donate_argnums=(5, 6))
+        else:
+            fn = jax.jit(step, donate_argnums=(5, 6))
+        self._compiled[k] = fn
+        return fn
+
+    def verify_sample(
+        self,
+        token_ids: "list[int]",  # [y0, drafts...]
+        prefix_len: int,
+        page_table: np.ndarray,
+        temperature: float,
+        top_k: int,
+        top_p: float,
+        min_p: float,
+        rope_pos: "np.ndarray | None" = None,
+    ) -> tuple[int, int]:
+        """Returns (final_token, n_accepted); the caller commits
+        ``token_ids[1:1+n_accepted] + [final_token]``."""
+        t = len(token_ids)
+        T = self.config.scheduler.prefill_bucket(t)
+        ps = self.config.cache.page_size
+        mp = len(page_table)
+        if prefix_len + t > mp * ps:
+            raise ValueError("verify chunk overruns page table")
+        tokens = np.zeros(T, np.int32)
+        tokens[:t] = token_ids
+        proposals = np.zeros(max(T - 1, 1), np.int32)
+        proposals[: t - 1] = token_ids[1:]
+        fn = self._verify_sample_fn(T, mp, use_mrope=rope_pos is not None)
+        args = [
+            self.params, self.inv_freq, jnp.asarray(tokens),
+            jnp.int32(prefix_len), jnp.int32(t),
+            self.k_cache, self.v_cache,
+            jnp.asarray(page_table, jnp.int32),
+            self._next_key(),
+            jnp.float32(temperature), jnp.int32(top_k),
+            jnp.float32(top_p), jnp.float32(min_p),
+            jnp.asarray(proposals), jnp.int32(t - 1),
+        ]
+        if rope_pos is not None:
+            rp = np.zeros((3, T), np.int32)
+            rp[:, :t] = rope_pos
+            args.append(jnp.asarray(rp))
+        final, n_acc, self.k_cache, self.v_cache = fn(*args)
+        return int(final), int(n_acc)
+
     def decode(
         self,
         tokens: np.ndarray,  # [B] int32

@@ -144,6 +144,81 @@ def sample_tokens_exact(
     return tokens, chosen
 
 
+def _filtered_probs(
+    logits: jnp.ndarray,  # [T, V] float32
+    temperature: jnp.ndarray,  # scalar (> 0)
+    top_k: jnp.ndarray,  # scalar int32 (-1 => disabled)
+    top_p: jnp.ndarray,  # scalar (1.0 => disabled)
+    min_p: jnp.ndarray,  # scalar (0.0 => disabled)
+) -> jnp.ndarray:
+    """Exact sequential temperature/top-k/top-p/min-p filtering shared by
+    all T rows (one request's verify chunk) -> renormalized probs [T, V].
+    Full-sort exact path (``sample_tokens_exact`` semantics): verify calls
+    are per-request and rare, so exactness beats the sort cost."""
+    T, V = logits.shape
+    z = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    order = jnp.argsort(-z, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)
+    z = jnp.where(ranks < k_eff, z, NEG_INF)
+    probs = jax.nn.softmax(z, axis=-1)
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+    cum_excl = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+    keep = jnp.take_along_axis(cum_excl < top_p, ranks, axis=-1)
+    z = jnp.where(keep, z, NEG_INF)
+    probs = jax.nn.softmax(z, axis=-1)
+    max_prob = probs.max(axis=-1, keepdims=True)
+    z = jnp.where(probs >= min_p * max_prob, z, NEG_INF)
+    return jax.nn.softmax(z, axis=-1)
+
+
+def spec_accept_sample(
+    logits: jnp.ndarray,  # [T, V] verify-forward logits (row i = dist after chunk[:i+1])
+    proposals: jnp.ndarray,  # [K] int32 draft tokens (padded; k_real valid)
+    k_real: jnp.ndarray,  # scalar int32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # scalar > 0
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    min_p: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Distribution-preserving speculative acceptance (rejection sampling,
+    Leviathan/Chen speculative sampling specialized to a DETERMINISTIC
+    draft).  The draft proposed token x_i deterministically, i.e. the
+    proposal distribution q_i is the point mass on x_i, so:
+
+    - accept x_i with probability min(1, p_i(x_i)/q_i(x_i)) = p_i(x_i);
+    - on first rejection, sample from the residual (p_i - q_i)+ / Z =
+      p_i with x_i zeroed, renormalized;
+    - with every proposal accepted, sample the bonus token from p_K.
+
+    The marginal distribution of the emitted tokens equals sampling from
+    the target's filtered distribution exactly (tests pin this with a
+    Monte-Carlo chi-square check).  Returns (final_token, n_accepted):
+    the caller commits ``proposals[:n_accepted] + [final_token]``."""
+    K = proposals.shape[0]
+    V = logits.shape[-1]
+    probs = _filtered_probs(logits, temperature, top_k, top_p, min_p)  # [T, V]
+    key_u, key_s = jax.random.split(key)
+    rows = jnp.arange(K)
+    p_prop = probs[rows, jnp.clip(proposals, 0, V - 1)]  # [K]
+    u = jax.random.uniform(key_u, (K,))
+    accept = (u < p_prop) & (rows < k_real)
+    n_acc = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    row = jnp.take(probs, jnp.minimum(n_acc, probs.shape[0] - 1), axis=0)  # [V]
+    is_bonus = n_acc >= k_real
+    rejected = jnp.clip(proposals[jnp.minimum(n_acc, K - 1)], 0, V - 1)
+    resid = row * (1.0 - jax.nn.one_hot(rejected, V, dtype=row.dtype))
+    resid_sum = resid.sum()
+    dist = jnp.where(
+        is_bonus | (resid_sum <= 0.0),
+        row,
+        resid / jnp.maximum(resid_sum, 1e-20),
+    )
+    final = jax.random.categorical(key_s, jnp.log(jnp.maximum(dist, 1e-38)))
+    return final.astype(jnp.int32), n_acc.astype(jnp.int32)
+
+
 def apply_penalties(
     logits: jnp.ndarray,  # [B, V]
     output_counts: jnp.ndarray,  # [B, V] int32: count of each token in the output so far

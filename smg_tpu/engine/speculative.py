@@ -6,15 +6,19 @@ context — the longest recent suffix n-gram that occurred earlier proposes
 the tokens that followed it — and the target model verifies all K in ONE
 prefill-shaped forward (MXU-batch instead of K sequential decode steps).
 
-Correctness: verification accepts exactly the greedy argmax chain, so
-speculative greedy output is token-identical to plain greedy decode (the
+Correctness: greedy verification accepts exactly the greedy argmax chain,
+so speculative greedy output is token-identical to plain greedy decode (the
 engine's parity tests pin this).  Rejected positions' KV lands beyond
 ``seq_len`` and is overwritten later — the same overshoot convention the
 stop-string rollback already relies on (KV past seq_len never enters the
 radix cache).
 
-Sampling (temperature > 0) requests are not speculated in v1 (exact
-rejection-sampling equivalence needs the full draft/target distributions).
+Since r5 sampling (temperature > 0) requests speculate too: acceptance runs
+ON DEVICE via rejection sampling specialized to a deterministic draft
+(``engine/sampling.py::spec_accept_sample`` — distribution-preserving,
+Monte-Carlo-pinned by tests), and a configured draft MODEL
+(``engine/draft.py``, ``EngineConfig.draft_model``) replaces n-gram lookup
+as the proposer.
 """
 
 from __future__ import annotations

@@ -333,10 +333,14 @@ class ResponsesHandler:
                 continue
             key = ar.get("approval_request_id") or ""
             approve = bool(ar.get("approve"))
+            # ownership: the key must appear in THIS caller's own chain /
+            # conversation history — a pending entry in the shared manager
+            # is not proof the caller issued it (cross-chain/tenant
+            # approval forgery otherwise)
+            info = self._find_approval_request(history_items, key)
+            if info is None:
+                raise RouteError(404, f"approval request {key!r} not found")
             if not self.approvals.has_pending(key):
-                info = self._find_approval_request(history_items, key)
-                if info is None:
-                    raise RouteError(404, f"approval request {key!r} not found")
                 self.approvals.restore(key, info.get("server_label", ""),
                                        info.get("name", ""),
                                        info.get("arguments", "{}"))

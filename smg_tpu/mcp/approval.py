@@ -139,7 +139,6 @@ class ApprovalManager:
         self.audit = audit or AuditLog()
         self.timeout = timeout
         self._pending: dict[str, PendingApproval] = {}
-        self._n_keys = 0
 
     def _evict_expired(self) -> None:
         now = time.monotonic()
@@ -165,8 +164,11 @@ class ApprovalManager:
         if decision is Decision.ALLOW and not force_approval:
             self.audit.record(server, tool, "allowed", reason, request_id)
             return None
-        self._n_keys += 1
-        key = f"mcpr_{self._n_keys:08x}"
+        import uuid
+
+        # unguessable: the key doubles as the client-facing item id and a
+        # sequential counter would let one caller aim at another's approvals
+        key = f"mcpr_{uuid.uuid4().hex[:20]}"
         pending = PendingApproval(key=key, server=server, tool=tool,
                                   arguments=arguments, request_id=request_id)
         self._pending[key] = pending

@@ -21,6 +21,10 @@ class McpInventory:
         self._servers: dict[str, McpToolServer] = {}
         # tenant -> allowed server names; absent tenant = all servers
         self._tenant_allow: dict[str, set[str]] = {}
+        # servers REGISTERED tenant-restricted; public servers never enter
+        # this set, so granting a tenant explicit access to a public server
+        # can't silently revoke it from everyone else
+        self._restricted: set[str] = set()
 
     # ---- catalog ----
 
@@ -30,11 +34,13 @@ class McpInventory:
         those tenants (and implicitly creates their allowlists)."""
         self._servers[server.name] = server
         if tenants:
+            self._restricted.add(server.name)
             for t in tenants:
                 self._tenant_allow.setdefault(t, set()).add(server.name)
 
     def remove_server(self, name: str) -> None:
         self._servers.pop(name, None)
+        self._restricted.discard(name)
         for allowed in self._tenant_allow.values():
             allowed.discard(name)
 
@@ -48,18 +54,12 @@ class McpInventory:
         return sorted(self._servers)
 
     def servers_for(self, tenant: str | None) -> list[str]:
-        """Visible servers: tenants with an allowlist see only it; tenants
-        without one (and anonymous callers) see the unrestricted servers —
-        servers registered with an explicit tenant list stay hidden."""
-        restricted: set[str] = set()
-        for allowed in self._tenant_allow.values():
-            restricted |= allowed
+        """Visible servers: everyone sees the public (unrestricted) ones;
+        servers registered with an explicit tenant list are visible only to
+        those tenants."""
+        visible = set(self._servers) - self._restricted
         if tenant is not None and tenant in self._tenant_allow:
-            visible = self._tenant_allow[tenant] | (
-                set(self._servers) - restricted
-            )
-        else:
-            visible = set(self._servers) - restricted
+            visible |= self._tenant_allow[tenant] & set(self._servers)
         return sorted(visible)
 
     def check_access(self, tenant: str | None, server_name: str) -> None:

@@ -67,6 +67,15 @@ class McpSession:
                                  s.name)
 
 
+def _fingerprint(registry: McpRegistry) -> tuple:
+    """Server identity set: name + URL (request-scoped HTTP servers can
+    re-point a label at a different URL between turns)."""
+    return tuple(sorted(
+        (name, getattr(srv, "url", ""))
+        for name, srv in registry._servers.items()
+    ))
+
+
 class SessionManager:
     """TTL-evicting session store (core/session.rs SessionPool analog)."""
 
@@ -101,9 +110,10 @@ class SessionManager:
         await self._evict()
         if session_id is not None and session_id in self._sessions:
             s = self._sessions[session_id]
-            # reuse only when the server set (and tenant) still matches —
-            # a turn adding request-level servers must not see a stale view
-            if s.tenant == tenant and s.registry.servers == registry.servers:
+            # reuse only when the server set (identity incl. URL, not just
+            # names — a re-labelled URL must not ride a stale connection)
+            # and tenant still match
+            if s.tenant == tenant and _fingerprint(s.registry) == _fingerprint(registry):
                 s.touch()
                 return s
             stale = self._sessions.pop(session_id, None)

@@ -113,13 +113,168 @@ def test_speculative_accepts_on_repetitive_context():
         eng.stop()
 
 
-def test_sampling_requests_not_speculated():
-    """temperature > 0 stays on the plain path (no spec counters move)."""
-    eng = _engine(True)
+def test_sampling_requests_speculated_with_rejection_sampling():
+    """temperature > 0 IS speculated since r5: device-side rejection
+    sampling accepts drafts distribution-preservingly (VERDICT r4 #4).
+    The n-gram proposer rarely fires on novel sampled continuations, so
+    the always-proposing draft-model engine carries the assertion."""
+    eng = _draft_engine(draft_seed=0)
     try:
-        ids = _generate(eng, [5, 6, 7, 5, 6, 7, 5, 6], n=8, temperature=0.8)
+        # low temperature: the filtered target distribution is peaked, so
+        # the same-weights draft's argmax carries most of the mass and
+        # acceptance is near-certain (at high T on a random tiny model the
+        # distribution is near-uniform over V=512 and acceptance ~1/V —
+        # correct, but nothing to assert on)
+        ids = _generate(eng, [5, 6, 7, 5, 6, 7, 5, 6], n=8, temperature=0.05)
         assert len(ids) == 8
-        assert eng.scheduler.num_spec_drafted == 0
+        assert eng.scheduler.num_spec_drafted > 0
+        assert eng.scheduler.num_spec_accepted > 0
+    finally:
+        eng.stop()
+
+
+def test_spec_accept_sample_preserves_distribution():
+    """Monte-Carlo check of the rejection-sampling identity: with a
+    deterministic draft, the emitted token at the FIRST position must be
+    distributed exactly as the target's filtered distribution — the
+    accept-or-residual split must not bias it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from smg_tpu.engine.sampling import _filtered_probs, spec_accept_sample
+
+    V, K = 8, 3
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((K + 1, V)) * 2.0, jnp.float32)
+    proposals = jnp.asarray([2, 5, 1], jnp.int32)  # arbitrary fixed drafts
+    temp, topk, topp, minp = (
+        jnp.float32(0.9), jnp.int32(-1), jnp.float32(1.0), jnp.float32(0.0)
+    )
+    target = np.asarray(_filtered_probs(logits, temp, topk, topp, minp))[0]
+
+    run = jax.jit(lambda key: spec_accept_sample(
+        logits, proposals, jnp.int32(K), key, temp, topk, topp, minp))
+    N = 20000
+    keys = jax.random.split(jax.random.PRNGKey(42), N)
+    finals, n_accs = jax.vmap(run)(keys)
+    finals, n_accs = np.asarray(finals), np.asarray(n_accs)
+    # first emitted token: proposals[0] when n_acc >= 1 else the residual
+    # sample (which IS the final token at position 0)
+    first = np.where(n_accs >= 1, int(proposals[0]), finals)
+    emp = np.bincount(first, minlength=V) / N
+    # ~3 sigma of a multinomial with N=20k: |err| < ~0.012 per bucket
+    np.testing.assert_allclose(emp, target, atol=0.015)
+
+
+def test_spec_accept_sample_respects_top_k():
+    """Tokens outside the filtered support can never be emitted."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from smg_tpu.engine.sampling import spec_accept_sample
+
+    V, K = 16, 2
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.standard_normal((K + 1, V)), jnp.float32)
+    allowed = {int(x) for row in np.asarray(
+        jax.lax.top_k(logits, 2)[1]) for x in row}
+    proposals = jnp.asarray([0, 1], jnp.int32)
+    run = jax.jit(lambda key: spec_accept_sample(
+        logits, proposals, jnp.int32(K), key,
+        jnp.float32(1.0), jnp.int32(2), jnp.float32(1.0), jnp.float32(0.0)))
+    keys = jax.random.split(jax.random.PRNGKey(7), 512)
+    finals, _ = jax.vmap(run)(keys)
+    assert set(np.asarray(finals).tolist()) <= allowed
+
+
+# ---- draft-model proposer ----
+
+
+def _draft_engine(draft_seed: int) -> Engine:
+    return Engine(EngineConfig(
+        model=tiny_test_config(),
+        draft_model=tiny_test_config(),  # same arch: tiny (tests only)
+        draft_seed=draft_seed,
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(2, 4),
+            speculative=True, spec_max_draft=4,
+        ),
+        dtype="float32", model_id="tiny-spec-draft",
+    ), tokenizer=MockTokenizer())
+
+
+def test_draft_model_greedy_parity_and_acceptance():
+    """Draft == target (same init seed): proposals are the target's own
+    argmaxes, so acceptance is total — far fewer steps than tokens — and
+    output is token-identical to plain greedy."""
+    plain = _engine(False)
+    spec = _draft_engine(draft_seed=0)  # == EngineConfig.seed -> same params
+    try:
+        prompt = list(range(40, 60))
+        want = _generate(plain, prompt)
+        got, steps = _generate(spec, prompt, count_steps=True)
+        assert got == want
+        assert spec.scheduler.num_spec_accepted > 0
+        assert steps < 24
+    finally:
+        plain.stop()
+        spec.stop()
+
+
+def test_draft_model_mismatched_weights_still_exact():
+    """A BAD draft (different weights) must not change greedy output —
+    verify gates every token."""
+    plain = _engine(False)
+    spec = _draft_engine(draft_seed=1234)
+    try:
+        for prompt in ([5, 6, 7, 5, 6, 7, 5, 6], list(range(70, 95))):
+            want = _generate(plain, prompt, n=16)
+            got = _generate(spec, prompt, n=16)
+            assert got == want
+    finally:
+        plain.stop()
+        spec.stop()
+
+
+def test_draft_model_survives_preemption():
+    """Preemption resets draft coverage (draft_len) with the pages; the
+    re-admitted request re-prefills its draft context and still finishes
+    exactly."""
+    eng = Engine(EngineConfig(
+        model=tiny_test_config(),
+        draft_model=tiny_test_config(),
+        draft_seed=0,
+        cache=CacheConfig(page_size=16, num_pages=12, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(2, 4),
+            speculative=True, spec_max_draft=4, watermark_pages=1,
+        ),
+        dtype="float32", model_id="tiny-spec-preempt",
+    ), tokenizer=MockTokenizer())
+    try:
+        done = {}
+
+        def cb(i, out):
+            done.setdefault(i, []).append(out)
+
+        for i in range(3):
+            eng.submit(list(range(10 + 3 * i, 40 + 3 * i)),
+                       SamplingParams(temperature=0.0, max_new_tokens=40,
+                                      ignore_eos=True),
+                       on_output=lambda o, i=i: cb(i, o))
+        for _ in range(600):
+            eng.step()
+            if len([k for k, v in done.items() if v and v[-1].finished]) == 3:
+                break
+        for i in range(3):
+            assert sum(len(o.new_token_ids) for o in done[i]) == 40
     finally:
         eng.stop()
 
