@@ -17,14 +17,17 @@ import os
 
 from smg_tpu.gateway.providers.anthropic import AnthropicAdapter
 from smg_tpu.gateway.providers.base import ProviderAdapter, ProviderSpec
+from smg_tpu.gateway.providers.bedrock import BedrockAdapter
 from smg_tpu.gateway.providers.gemini import GeminiAdapter
 from smg_tpu.gateway.providers.openai import OpenAIAdapter
+from smg_tpu.gateway.providers.xai import XAIAdapter
 
 _ADAPTERS = {
     "openai": OpenAIAdapter,
-    "xai": OpenAIAdapter,  # OpenAI-compatible wire format
+    "xai": XAIAdapter,  # OpenAI chat wire + Responses input rewrite
     "anthropic": AnthropicAdapter,
     "gemini": GeminiAdapter,
+    "bedrock": BedrockAdapter,
 }
 
 

@@ -1023,146 +1023,37 @@ class Router:
     # ---- Anthropic Messages ----
 
     async def anthropic_messages(self, req, request_id: str | None = None):
-        """Non-streaming Anthropic /v1/messages (reference: anthropic router)."""
-        from smg_tpu.protocols.anthropic import (
-            AnthropicContentBlock,
-            AnthropicMessagesResponse,
-            AnthropicUsage,
-            map_stop_reason,
+        """Non-streaming Anthropic /v1/messages (reference: anthropic
+        router).  Format translation lives in ``gateway/openai_bridge.py``
+        — shared with the 3rd-party provider path."""
+        from smg_tpu.gateway.openai_bridge import (
+            anthropic_to_openai_request,
+            openai_to_anthropic_response,
         )
 
-        chat_req = self._anthropic_to_chat(req)
+        chat_req = anthropic_to_openai_request(req)
         resp = await self.chat(chat_req, request_id=request_id)
-        choice = resp.choices[0]
-        blocks: list[AnthropicContentBlock] = []
-        if choice.message.content:
-            blocks.append(AnthropicContentBlock(type="text", text=choice.message.content))
-        if choice.message.tool_calls:
-            import json as _json
-
-            for tc in choice.message.tool_calls:
-                try:
-                    args = _json.loads(tc.function.arguments or "{}")
-                except Exception:
-                    args = {}
-                blocks.append(
-                    AnthropicContentBlock(
-                        type="tool_use", id=tc.id, name=tc.function.name, input=args
-                    )
-                )
-        return AnthropicMessagesResponse(
-            model=req.model or "default",
-            content=blocks,
-            stop_reason=map_stop_reason(choice.finish_reason),
-            usage=AnthropicUsage(
-                input_tokens=resp.usage.prompt_tokens,
-                output_tokens=resp.usage.completion_tokens,
-                cache_read_input_tokens=(resp.usage.prompt_tokens_details or {}).get(
-                    "cached_tokens", 0
-                ),
-            ),
-        )
+        return openai_to_anthropic_response(resp, req.model)
 
     async def anthropic_messages_stream(self, req, request_id: str | None = None):
-        """Anthropic streaming events: message_start, content_block_start,
-        content_block_delta (text_delta), content_block_stop, message_delta,
+        """Anthropic streaming events via the shared bridge grammar:
+        message_start, content_block_start, content_block_delta
+        (text_delta | input_json_delta), content_block_stop, message_delta,
         message_stop."""
-        from smg_tpu.protocols.anthropic import map_stop_reason
-
+        from smg_tpu.gateway.openai_bridge import (
+            anthropic_to_openai_request,
+            openai_chunks_to_anthropic_events,
+        )
         from smg_tpu.protocols.openai import StreamOptions
 
-        chat_req = self._anthropic_to_chat(req)
+        chat_req = anthropic_to_openai_request(req)
         chat_req.stream = True
         chat_req.stream_options = StreamOptions(include_usage=True)
-        mid = f"msg_{uuid.uuid4().hex[:24]}"
-        yield "message_start", {
-            "type": "message_start",
-            "message": {
-                "id": mid, "type": "message", "role": "assistant",
-                "model": req.model or "default", "content": [],
-                "usage": {"input_tokens": 0, "output_tokens": 0},
-            },
-        }
-        finish = None
-        in_tokens = out_tokens = 0
-        block_idx = -1
-        text_block_open = False
-        async for chunk in self.chat_stream(chat_req, request_id=request_id):
-            if chunk.usage is not None:
-                in_tokens = chunk.usage.prompt_tokens
-                out_tokens = chunk.usage.completion_tokens
-                continue
-            for ch in chunk.choices:
-                if ch.delta.content:
-                    if not text_block_open:
-                        block_idx += 1
-                        text_block_open = True
-                        yield "content_block_start", {
-                            "type": "content_block_start", "index": block_idx,
-                            "content_block": {"type": "text", "text": ""},
-                        }
-                    yield "content_block_delta", {
-                        "type": "content_block_delta", "index": block_idx,
-                        "delta": {"type": "text_delta", "text": ch.delta.content},
-                    }
-                for tc in ch.delta.tool_calls or []:
-                    if text_block_open:
-                        yield "content_block_stop", {
-                            "type": "content_block_stop", "index": block_idx,
-                        }
-                        text_block_open = False
-                    block_idx += 1
-                    yield "content_block_start", {
-                        "type": "content_block_start", "index": block_idx,
-                        "content_block": {
-                            "type": "tool_use", "id": tc.id,
-                            "name": tc.function.name or "", "input": {},
-                        },
-                    }
-                    yield "content_block_delta", {
-                        "type": "content_block_delta", "index": block_idx,
-                        "delta": {
-                            "type": "input_json_delta",
-                            "partial_json": tc.function.arguments or "{}",
-                        },
-                    }
-                    yield "content_block_stop", {
-                        "type": "content_block_stop", "index": block_idx,
-                    }
-                if ch.finish_reason:
-                    finish = ch.finish_reason
-        if text_block_open:
-            yield "content_block_stop", {"type": "content_block_stop", "index": block_idx}
-        yield "message_delta", {
-            "type": "message_delta",
-            "delta": {"stop_reason": map_stop_reason(finish), "stop_sequence": None},
-            "usage": {"input_tokens": in_tokens, "output_tokens": out_tokens},
-        }
-        yield "message_stop", {"type": "message_stop"}
-
-    def _anthropic_to_chat(self, req) -> ChatCompletionRequest:
-        from smg_tpu.protocols.openai import FunctionDef, Tool
-
-        tools = None
-        if req.tools:
-            tools = [
-                Tool(function=FunctionDef(
-                    name=t.name, description=t.description, parameters=t.input_schema
-                ))
-                for t in req.tools
-            ]
-        return ChatCompletionRequest(
-            model=req.model,
-            messages=[ChatMessage.model_validate(m) for m in req.to_chat_messages()],
-            max_tokens=req.max_tokens,
-            temperature=req.temperature,
-            top_p=req.top_p,
-            top_k=req.top_k,
-            stop=req.stop_sequences,
-            tools=tools,
-            stream=req.stream,
-            stream_options=None,
-        )
+        chunks = self.chat_stream(chat_req, request_id=request_id)
+        async for name, payload in openai_chunks_to_anthropic_events(
+            chunks, req.model
+        ):
+            yield name, payload
 
     # ---- completions ----
 

@@ -817,6 +817,11 @@ async def h_anthropic_messages(request: web.Request) -> web.Response | web.Strea
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
+    adapter = ctx.providers.resolve(req.model)
+    if adapter is not None:
+        # openai_bridge: the Anthropic front door over an OpenAI-format
+        # provider backend (reference: openai_bridge/transformer.rs)
+        return await _messages_via_provider(request, ctx, adapter, req)
     async with ctx.semaphore:
         if not req.stream:
             resp = await ctx.router_for(req.model).anthropic_messages(req, request_id=rid)
@@ -830,6 +835,63 @@ async def h_anthropic_messages(request: web.Request) -> web.Response | web.Strea
                 )
         except RouteError as e:
             err = {"type": "error", "error": {"type": e.err_type, "message": e.message}}
+            await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def _messages_via_provider(request, ctx, adapter, req) -> web.Response | web.StreamResponse:
+    """Anthropic /v1/messages served by an OpenAI-format provider backend
+    through the shared bridge transformers."""
+    from smg_tpu.gateway.openai_bridge import (
+        anthropic_to_openai_request,
+        openai_chunks_to_anthropic_events,
+        openai_to_anthropic_response,
+    )
+    from smg_tpu.gateway.providers import ProviderError
+    from smg_tpu.protocols.openai import (
+        ChatCompletionResponse,
+        ChatCompletionStreamChunk,
+        StreamOptions,
+    )
+
+    chat_req = anthropic_to_openai_request(req)
+    if req.stream:
+        # OpenAI-format upstreams only emit the usage frame when asked —
+        # without it message_delta would always meter zero tokens
+        chat_req.stream_options = StreamOptions(include_usage=True)
+    async with ctx.semaphore:
+        if not req.stream:
+            try:
+                data = await adapter.chat(chat_req)
+            except ProviderError as e:
+                return _error(502 if e.status >= 500 else e.status,
+                              f"provider error: {e.message}", "provider_error")
+            except Exception as e:
+                return _error(502, f"provider unreachable: {e}", "provider_error")
+            resp = openai_to_anthropic_response(
+                ChatCompletionResponse.model_validate(data), req.model
+            )
+            return web.json_response(resp.model_dump(exclude_none=True))
+        sse = _sse_response(request)
+        await sse.prepare(request)
+
+        async def chunks():
+            async for raw in adapter.chat_stream(chat_req):
+                yield ChatCompletionStreamChunk.model_validate(raw)
+
+        try:
+            async for name, payload in openai_chunks_to_anthropic_events(
+                chunks(), req.model
+            ):
+                await sse.write(
+                    f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+        except ProviderError as e:
+            err = {"type": "error", "error": {"type": "provider_error", "message": e.message}}
+            await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
+        except Exception as e:
+            err = {"type": "error", "error": {"type": "provider_error", "message": str(e)}}
             await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
         await sse.write_eof()
         return sse
@@ -899,6 +961,15 @@ async def h_responses_create(request: web.Request) -> web.Response | web.StreamR
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
     tenant = request.get("tenant")
+    adapter = ctx.providers.resolve(req.model)
+    if adapter is not None:
+        if hasattr(adapter, "responses"):
+            # Responses-capable providers (xAI) take the request upstream
+            # with their input rewrite
+            return await _responses_via_provider(request, ctx, adapter, req)
+        # chat-only providers: synthesize the Responses result over the
+        # adapter's chat surface (the local loop has no worker for them)
+        return await _responses_via_chat_adapter(request, ctx, adapter, req)
     async with ctx.semaphore:
         if not req.stream:
             resp = await ctx.responses.create(req, request_id=rid, tenant=tenant)
@@ -915,6 +986,97 @@ async def h_responses_create(request: web.Request) -> web.Response | web.StreamR
             await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
         await sse.write_eof()
         return sse
+
+
+async def _responses_via_provider(request, ctx, adapter, req) -> web.Response | web.StreamResponse:
+    from smg_tpu.gateway.providers import ProviderError
+
+    body = req.model_dump(exclude_none=True, exclude_unset=True)
+    async with ctx.semaphore:
+        if not req.stream:
+            try:
+                data = await adapter.responses(body)
+            except ProviderError as e:
+                return _error(502 if e.status >= 500 else e.status,
+                              f"provider error: {e.message}", "provider_error")
+            except Exception as e:
+                return _error(502, f"provider unreachable: {e}", "provider_error")
+            return web.json_response(data)
+        sse = _sse_response(request)
+        await sse.prepare(request)
+        try:
+            async for name, payload in adapter.responses_stream(body):
+                await sse.write(
+                    f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+        except ProviderError as e:
+            err = {"type": "error", "error": {"message": e.message, "type": "provider_error"}}
+            await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
+        except Exception as e:
+            err = {"type": "error", "error": {"message": str(e), "type": "provider_error"}}
+            await sse.write(f"event: error\ndata: {json.dumps(err)}\n\n".encode())
+        await sse.write_eof()
+        return sse
+
+
+async def _responses_via_chat_adapter(request, ctx, adapter, req) -> web.Response:
+    """Minimal Responses synthesis over a chat-only provider adapter: the
+    input becomes chat messages, the chat answer becomes message /
+    function_call output items.  Tool EXECUTION loops stay on the local
+    handler — provider models get the single-shot surface."""
+    from smg_tpu.gateway.providers import ProviderError
+    from smg_tpu.protocols.openai import ChatCompletionRequest, ChatCompletionResponse
+    from smg_tpu.protocols.responses import ResponsesResponse, ResponseUsage
+
+    handler = ctx.responses
+    messages = []
+    if req.instructions:
+        from smg_tpu.protocols.openai import ChatMessage
+
+        messages.append(ChatMessage(role="system", content=req.instructions))
+    if isinstance(req.input, str):
+        from smg_tpu.protocols.openai import ChatMessage
+
+        messages.append(ChatMessage(role="user", content=req.input))
+    else:
+        for item in req.input:
+            messages.extend(handler._item_to_messages(
+                item.get("type", "message"), item.get("role"), item
+            ))
+    chat_req = ChatCompletionRequest(
+        model=req.model, messages=messages,
+        temperature=req.temperature, top_p=req.top_p,
+        max_tokens=req.max_output_tokens,
+        tools=[t for t in (req.tools or []) if t.get("type") == "function"] or None,
+    )
+    async with ctx.semaphore:
+        try:
+            data = await adapter.chat(chat_req)
+        except ProviderError as e:
+            return _error(502 if e.status >= 500 else e.status,
+                          f"provider error: {e.message}", "provider_error")
+        except Exception as e:
+            return _error(502, f"provider unreachable: {e}", "provider_error")
+    resp = ChatCompletionResponse.model_validate(data)
+    choice = resp.choices[0]
+    output = []
+    if choice.message.content:
+        output.append({"type": "message", "role": "assistant",
+                       "content": [{"type": "output_text",
+                                    "text": choice.message.content}]})
+    for tc in choice.message.tool_calls or []:
+        output.append({"type": "function_call", "call_id": tc.id or "call_0",
+                       "name": tc.function.name or "",
+                       "arguments": tc.function.arguments or "{}"})
+    usage = ResponseUsage(
+        input_tokens=resp.usage.prompt_tokens,
+        output_tokens=resp.usage.completion_tokens,
+        total_tokens=resp.usage.total_tokens,
+    )
+    out = ResponsesResponse(model=req.model or "default", status="completed",
+                            output=output, usage=usage,
+                            metadata=req.metadata or {})
+    return web.json_response(out.model_dump(exclude_none=True))
 
 
 async def h_responses_get(request: web.Request) -> web.Response:

@@ -1,0 +1,586 @@
+"""Provider breadth r5 (VERDICT r4 next-round #5): xAI adapter with the
+Responses-input rewrite, AWS Bedrock Converse adapter with SigV4 signing,
+and the openai_bridge (Anthropic /v1/messages front over OpenAI-format
+provider backends) — all against protocol-accurate local mock upstreams."""
+
+import asyncio
+import datetime
+import json
+import threading
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from smg_tpu.gateway.providers import ProviderSpec
+from smg_tpu.gateway.providers.bedrock import (
+    chat_to_converse,
+    converse_to_chat,
+    sigv4_headers,
+)
+from smg_tpu.gateway.providers.xai import transform_responses_input
+from smg_tpu.gateway.server import AppContext, build_app
+
+# ---------------- unit: xai input rewrite ----------------
+
+
+def test_xai_responses_input_rewrite():
+    body = {
+        "model": "grok-4",
+        "input": [
+            {"type": "message", "role": "user", "id": "itm_1", "status": "done",
+             "content": [{"type": "input_text", "text": "hi"}]},
+            {"type": "message", "role": "assistant",
+             "content": [{"type": "output_text", "text": "prior answer"}]},
+        ],
+    }
+    out = transform_responses_input(body)
+    assert "id" not in out["input"][0] and "status" not in out["input"][0]
+    assert out["input"][0]["content"][0]["type"] == "input_text"  # untouched
+    assert out["input"][1]["content"][0]["type"] == "input_text"  # rewritten
+    assert out["input"][1]["content"][0]["text"] == "prior answer"
+
+
+def test_xai_rewrite_ignores_string_input():
+    assert transform_responses_input({"input": "plain"})["input"] == "plain"
+
+
+# ---------------- unit: bedrock translation ----------------
+
+
+def test_chat_to_converse_shapes():
+    from smg_tpu.protocols.openai import (
+        ChatCompletionRequest,
+        ChatMessage,
+        FunctionDef,
+        Tool,
+    )
+
+    req = ChatCompletionRequest(
+        model="bedrock/claude", max_tokens=64, temperature=0.3, top_p=0.9,
+        stop=["END"],
+        messages=[
+            ChatMessage(role="system", content="be brief"),
+            ChatMessage(role="user", content="weather?"),
+            ChatMessage(role="assistant", content=None, tool_calls=[{
+                "id": "t1", "type": "function",
+                "function": {"name": "get_weather", "arguments": '{"c": "P"}'},
+            }]),
+            ChatMessage(role="tool", content="18C", tool_call_id="t1"),
+        ],
+        tools=[Tool(function=FunctionDef(name="get_weather", description="w",
+                                         parameters={"type": "object"}))],
+    )
+    body = chat_to_converse(req)
+    assert body["system"] == [{"text": "be brief"}]
+    assert body["messages"][0] == {"role": "user", "content": [{"text": "weather?"}]}
+    tu = body["messages"][1]["content"][0]["toolUse"]
+    assert tu["name"] == "get_weather" and tu["input"] == {"c": "P"}
+    tr = body["messages"][2]["content"][0]["toolResult"]
+    assert tr["toolUseId"] == "t1"
+    assert body["inferenceConfig"] == {
+        "maxTokens": 64, "temperature": 0.3, "topP": 0.9, "stopSequences": ["END"],
+    }
+    spec = body["toolConfig"]["tools"][0]["toolSpec"]
+    assert spec["name"] == "get_weather"
+    assert spec["inputSchema"] == {"json": {"type": "object"}}
+
+
+def test_converse_to_chat_tool_use():
+    data = {
+        "output": {"message": {"role": "assistant", "content": [
+            {"text": "checking"},
+            {"toolUse": {"toolUseId": "tu1", "name": "f", "input": {"a": 1}}},
+        ]}},
+        "stopReason": "tool_use",
+        "usage": {"inputTokens": 5, "outputTokens": 9, "totalTokens": 14},
+    }
+    out = converse_to_chat(data, "bedrock/claude")
+    msg = out["choices"][0]["message"]
+    assert msg["content"] == "checking"
+    assert msg["tool_calls"][0]["function"]["name"] == "f"
+    assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) == {"a": 1}
+    assert out["choices"][0]["finish_reason"] == "tool_calls"
+    assert out["usage"]["total_tokens"] == 14
+
+
+def test_sigv4_deterministic_and_secret_sensitive():
+    now = datetime.datetime(2026, 7, 30, 12, 0, 0, tzinfo=datetime.timezone.utc)
+    h1 = sigv4_headers("POST", "https://bedrock-runtime.us-west-2.amazonaws.com/model/m/converse",
+                       b"{}", "AKID", "SECRET", "us-west-2", now=now)
+    h2 = sigv4_headers("POST", "https://bedrock-runtime.us-west-2.amazonaws.com/model/m/converse",
+                       b"{}", "AKID", "SECRET", "us-west-2", now=now)
+    h3 = sigv4_headers("POST", "https://bedrock-runtime.us-west-2.amazonaws.com/model/m/converse",
+                       b"{}", "AKID", "OTHER", "us-west-2", now=now)
+    assert h1 == h2
+    assert h1["authorization"] != h3["authorization"]
+    assert h1["x-amz-date"] == "20260730T120000Z"
+    assert h1["authorization"].startswith(
+        "AWS4-HMAC-SHA256 Credential=AKID/20260730/us-west-2/bedrock/aws4_request, "
+        "SignedHeaders=host;x-amz-date, Signature="
+    )
+
+
+# ---------------- mock upstreams ----------------
+
+
+def make_mock_xai(seen: list):
+    async def chat(request: web.Request):
+        body = await request.json()
+        seen.append({"path": "/chat/completions", "body": body})
+        return web.json_response({
+            "id": "x1", "object": "chat.completion", "model": body["model"],
+            "choices": [{"index": 0, "message": {"role": "assistant",
+                                                 "content": "grok says hi"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 2, "total_tokens": 5},
+        })
+
+    async def responses(request: web.Request):
+        body = await request.json()
+        seen.append({"path": "/responses", "body": body})
+        return web.json_response({
+            "id": "resp_x1", "object": "response", "status": "completed",
+            "model": body["model"],
+            "output": [{"type": "message", "role": "assistant",
+                        "content": [{"type": "output_text", "text": "ok"}]}],
+        })
+
+    app = web.Application()
+    app.router.add_post("/chat/completions", chat)
+    app.router.add_post("/responses", responses)
+    return app
+
+
+def make_mock_bedrock(seen: list):
+    async def converse(request: web.Request):
+        body = await request.json()
+        seen.append({
+            "path": str(request.path),
+            "headers": {k.lower(): v for k, v in request.headers.items()},
+            "body": body,
+        })
+        if body.get("toolConfig"):
+            content = [{"toolUse": {"toolUseId": "tu1", "name": "get_weather",
+                                    "input": {"city": "Paris"}}}]
+            stop = "tool_use"
+        else:
+            content = [{"text": "bedrock says hi"}]
+            stop = "end_turn"
+        return web.json_response({
+            "output": {"message": {"role": "assistant", "content": content}},
+            "stopReason": stop,
+            "usage": {"inputTokens": 4, "outputTokens": 6, "totalTokens": 10},
+        })
+
+    async def converse_stream(request: web.Request):
+        body = await request.json()
+        seen.append({"path": str(request.path), "body": body})
+        resp = web.StreamResponse(headers={"content-type": "text/event-stream"})
+        await resp.prepare(request)
+        frames = [
+            {"messageStart": {"role": "assistant"}},
+            {"contentBlockDelta": {"delta": {"text": "hi "}, "contentBlockIndex": 0}},
+            {"contentBlockDelta": {"delta": {"text": "from bedrock"}, "contentBlockIndex": 0}},
+            {"contentBlockStart": {"start": {"toolUse": {
+                "toolUseId": "tu9", "name": "get_weather"}}, "contentBlockIndex": 1}},
+            {"contentBlockDelta": {"delta": {"toolUse": {"input": '{"city":'}},
+             "contentBlockIndex": 1}},
+            {"contentBlockDelta": {"delta": {"toolUse": {"input": ' "Paris"}'}},
+             "contentBlockIndex": 1}},
+            {"messageStop": {"stopReason": "tool_use"}},
+            {"metadata": {"usage": {"inputTokens": 4, "outputTokens": 6,
+                                    "totalTokens": 10}}},
+        ]
+        for f in frames:
+            await resp.write(f"data: {json.dumps(f)}\n\n".encode())
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_post("/model/{model}/converse", converse)
+    app.router.add_post("/model/{model}/converse-stream", converse_stream)
+    return app
+
+
+def make_mock_openai_for_bridge(seen: list):
+    async def chat(request: web.Request):
+        body = await request.json()
+        seen.append({"body": body})
+        if body.get("stream"):
+            resp = web.StreamResponse(headers={"content-type": "text/event-stream"})
+            await resp.prepare(request)
+            frames = [
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {"role": "assistant"}}]},
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {"content": "bridged "}}]},
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {"content": "text"}}]},
+                # protocol-accurate fragmented tool-call streaming: opening
+                # delta carries id+name, arguments arrive as bare fragments
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {
+                     "tool_calls": [{"index": 0, "id": "call_7", "type": "function",
+                                     "function": {"name": "f", "arguments": ""}}]
+                 }}]},
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {
+                     "tool_calls": [{"index": 0,
+                                     "function": {"arguments": '{"x":'}}]
+                 }}]},
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {
+                     "tool_calls": [{"index": 0,
+                                     "function": {"arguments": " 1}"}}]
+                 }}]},
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [{"index": 0, "delta": {}, "finish_reason": "tool_calls"}]},
+                {"id": "u1", "object": "chat.completion.chunk", "model": body["model"],
+                 "choices": [],
+                 "usage": {"prompt_tokens": 11, "completion_tokens": 7,
+                           "total_tokens": 18}},
+            ]
+            for f in frames:
+                await resp.write(f"data: {json.dumps(f)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response({
+            "id": "u1", "object": "chat.completion", "model": body["model"],
+            "choices": [{"index": 0, "message": {
+                "role": "assistant", "content": "bridged answer",
+                "tool_calls": [{"id": "call_9", "type": "function",
+                                "function": {"name": "f",
+                                             "arguments": '{"x": 2}'}}],
+            }, "finish_reason": "tool_calls"}],
+            "usage": {"prompt_tokens": 5, "completion_tokens": 4, "total_tokens": 9},
+        })
+
+    app = web.Application()
+    app.router.add_post("/chat/completions", chat)
+    return app
+
+
+# ---------------- fixture ----------------
+
+
+@pytest.fixture(scope="module")
+def v2_gateway():
+    loop = asyncio.new_event_loop()
+    seen = {"xai": [], "bedrock": [], "bridge": []}
+    ctx = AppContext(policy="round_robin")
+
+    async def _setup():
+        mocks = {}
+        for kind, maker in (("xai", make_mock_xai),
+                            ("bedrock", make_mock_bedrock),
+                            ("bridge", make_mock_openai_for_bridge)):
+            server = TestServer(maker(seen[kind]))
+            await server.start_server()
+            mocks[kind] = server
+        ctx.providers.register(ProviderSpec(
+            name="xai", kind="xai",
+            base_url=str(mocks["xai"].make_url("")).rstrip("/"),
+            api_key="xai-test", models=["grok-4"],
+        ))
+        ctx.providers.register(ProviderSpec(
+            name="bedrock", kind="bedrock",
+            base_url=str(mocks["bedrock"].make_url("")).rstrip("/"),
+            api_key="AKID:SECRET",
+            models=["anthropic.claude-3-sonnet"],
+        ))
+        ctx.providers.register(ProviderSpec(
+            name="oai-bridge", kind="openai",
+            base_url=str(mocks["bridge"].make_url("")).rstrip("/"),
+            api_key="sk-b", models=["bridge-model"],
+        ))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc, mocks
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=60)
+
+    tc, mocks = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.client, h.seen = run, tc, seen
+    yield h
+    run(tc.close())
+    for s in mocks.values():
+        run(s.close())
+    loop.call_soon_threadsafe(loop.stop)
+
+
+# ---------------- xai ----------------
+
+
+def test_xai_chat_roundtrip(v2_gateway):
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "grok-4", "messages": [{"role": "user", "content": "hi"}],
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["choices"][0]["message"]["content"] == "grok says hi"
+
+
+def test_xai_responses_upstream_rewrite(v2_gateway):
+    """The gateway rewrites replayed output_text items before xAI sees them."""
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/responses", json={
+            "model": "grok-4",
+            "input": [
+                {"type": "message", "role": "user", "id": "a", "status": "done",
+                 "content": [{"type": "input_text", "text": "q"}]},
+                {"type": "message", "role": "assistant",
+                 "content": [{"type": "output_text", "text": "prev"}]},
+            ],
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["status"] == "completed"
+    up = next(s for s in h.seen["xai"] if s["path"] == "/responses")
+    items = up["body"]["input"]
+    assert "id" not in items[0]
+    assert items[1]["content"][0]["type"] == "input_text"
+
+
+# ---------------- bedrock ----------------
+
+
+def test_bedrock_chat_roundtrip_signed(v2_gateway):
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "anthropic.claude-3-sonnet",
+            "messages": [{"role": "system", "content": "brief"},
+                         {"role": "user", "content": "hello"}],
+            "max_tokens": 32,
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["choices"][0]["message"]["content"] == "bedrock says hi"
+    assert body["usage"]["total_tokens"] == 10
+    up = h.seen["bedrock"][-1]
+    assert up["path"].endswith("/converse")
+    assert up["body"]["system"] == [{"text": "brief"}]
+    assert up["body"]["messages"] == [
+        {"role": "user", "content": [{"text": "hello"}]}
+    ]
+    auth = up["headers"]["authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKID/")
+    assert "SignedHeaders=host;x-amz-date" in auth
+    assert "x-amz-date" in up["headers"]
+
+
+def test_bedrock_tool_calls(v2_gateway):
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "anthropic.claude-3-sonnet",
+            "messages": [{"role": "user", "content": "weather?"}],
+            "tools": [{"type": "function", "function": {
+                "name": "get_weather", "parameters": {"type": "object"}}}],
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    tc = body["choices"][0]["message"]["tool_calls"][0]
+    assert tc["function"]["name"] == "get_weather"
+    assert json.loads(tc["function"]["arguments"]) == {"city": "Paris"}
+    assert body["choices"][0]["finish_reason"] == "tool_calls"
+
+
+def test_bedrock_streaming(v2_gateway):
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "anthropic.claude-3-sonnet", "stream": True,
+            "messages": [{"role": "user", "content": "weather?"}],
+        })
+        return await r.text()
+
+    raw = h.run(go())
+    chunks = [json.loads(l[6:]) for l in raw.splitlines()
+              if l.startswith("data: ") and l != "data: [DONE]"]
+    text = "".join(c["choices"][0]["delta"].get("content") or ""
+                   for c in chunks if c.get("choices"))
+    assert text == "hi from bedrock"
+    opens = [tc for c in chunks if c.get("choices")
+             for tc in c["choices"][0]["delta"].get("tool_calls") or []
+             if (tc.get("function") or {}).get("name")]
+    assert opens and opens[0]["function"]["name"] == "get_weather"
+    args = "".join(tc["function"].get("arguments") or ""
+                   for c in chunks if c.get("choices")
+                   for tc in c["choices"][0]["delta"].get("tool_calls") or [])
+    assert json.loads(args) == {"city": "Paris"}
+    finishes = [c["choices"][0].get("finish_reason")
+                for c in chunks if c.get("choices")]
+    assert "tool_calls" in finishes
+    usage = [c["usage"] for c in chunks if c.get("usage")]
+    assert usage and usage[-1]["total_tokens"] == 10
+
+
+# ---------------- openai_bridge: anthropic front over openai provider ----------------
+
+
+def test_bridge_messages_roundtrip(v2_gateway):
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/messages", json={
+            "model": "bridge-model", "max_tokens": 64,
+            "messages": [{"role": "user", "content": "do it"}],
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    types = [b["type"] for b in body["content"]]
+    assert types == ["text", "tool_use"]
+    assert body["content"][0]["text"] == "bridged answer"
+    assert body["content"][1]["name"] == "f"
+    assert body["content"][1]["input"] == {"x": 2}
+    assert body["stop_reason"] == "tool_use"
+    assert body["usage"]["input_tokens"] == 5
+    # the upstream saw an OPENAI-format request
+    up = h.seen["bridge"][-1]["body"]
+    assert up["messages"] == [{"role": "user", "content": "do it"}]
+
+
+def test_bridge_messages_streaming_grammar(v2_gateway):
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/messages", json={
+            "model": "bridge-model", "max_tokens": 64, "stream": True,
+            "messages": [{"role": "user", "content": "do it"}],
+        })
+        return await r.text()
+
+    raw = h.run(go())
+    events = []
+    for block in raw.split("\n\n"):
+        name = data = None
+        for line in block.splitlines():
+            if line.startswith("event: "):
+                name = line[7:]
+            elif line.startswith("data: "):
+                data = json.loads(line[6:])
+        if name:
+            events.append((name, data))
+    names = [n for n, _ in events]
+    assert names[0] == "message_start"
+    assert names[-2:] == ["message_delta", "message_stop"]
+    text = "".join(d["delta"]["text"] for n, d in events
+                   if n == "content_block_delta"
+                   and d["delta"]["type"] == "text_delta")
+    assert text == "bridged text"
+    tools = [d for n, d in events if n == "content_block_start"
+             and d["content_block"]["type"] == "tool_use"]
+    assert len(tools) == 1, "fragmented args must NOT open extra blocks"
+    assert tools[0]["content_block"]["name"] == "f"
+    assert tools[0]["content_block"]["id"] == "call_7"
+    tool_idx = tools[0]["index"]
+    frags = [d["delta"]["partial_json"] for n, d in events
+             if n == "content_block_delta"
+             and d["delta"]["type"] == "input_json_delta"
+             and d["index"] == tool_idx]
+    assert json.loads("".join(frags)) == {"x": 1}
+    # the tool_use block closes exactly once
+    stops = [d for n, d in events if n == "content_block_stop"
+             and d["index"] == tool_idx]
+    assert len(stops) == 1
+    md = next(d for n, d in events if n == "message_delta")
+    assert md["delta"]["stop_reason"] == "tool_use"
+    assert md["usage"] == {"input_tokens": 11, "output_tokens": 7}
+
+
+def test_bridge_requests_usage_frame(v2_gateway):
+    """The provider bridge must ask the upstream for the usage frame."""
+    h = v2_gateway
+
+    async def go():
+        await h.client.post("/v1/messages", json={
+            "model": "bridge-model", "max_tokens": 8, "stream": True,
+            "messages": [{"role": "user", "content": "x"}],
+        })
+        return h.seen["bridge"][-1]["body"]
+
+    body = h.run(go())
+    assert (body.get("stream_options") or {}).get("include_usage") is True
+
+
+def test_responses_via_chat_only_provider(v2_gateway):
+    """A chat-only provider model still serves /v1/responses (synthesized
+    over adapter.chat)."""
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/responses", json={
+            "model": "bridge-model", "input": "do it",
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    kinds = [o["type"] for o in body["output"]]
+    assert "message" in kinds and "function_call" in kinds
+    msg = next(o for o in body["output"] if o["type"] == "message")
+    assert msg["content"][0]["text"] == "bridged answer"
+    fc = next(o for o in body["output"] if o["type"] == "function_call")
+    assert fc["name"] == "f" and json.loads(fc["arguments"]) == {"x": 2}
+    assert body["usage"]["total_tokens"] == 9
+
+
+def test_bedrock_merges_consecutive_user_turns(v2_gateway):
+    """Parallel tool results + the next user turn must merge into ONE
+    Converse user message (Bedrock requires role alternation)."""
+    h = v2_gateway
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "anthropic.claude-3-sonnet",
+            "messages": [
+                {"role": "user", "content": "weather in two cities"},
+                {"role": "assistant", "content": None, "tool_calls": [
+                    {"id": "t1", "type": "function",
+                     "function": {"name": "w", "arguments": '{"c": "P"}'}},
+                    {"id": "t2", "type": "function",
+                     "function": {"name": "w", "arguments": '{"c": "L"}'}},
+                ]},
+                {"role": "tool", "content": "18C", "tool_call_id": "t1"},
+                {"role": "tool", "content": "15C", "tool_call_id": "t2"},
+                {"role": "user", "content": "so which is warmer?"},
+            ],
+        })
+        return r.status, h.seen["bedrock"][-1]["body"]
+
+    status, body = h.run(go())
+    assert status == 200
+    roles = [m["role"] for m in body["messages"]]
+    assert roles == ["user", "assistant", "user"], roles
+    merged = body["messages"][2]["content"]
+    assert [list(b)[0] for b in merged] == ["toolResult", "toolResult", "text"]
