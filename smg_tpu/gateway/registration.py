@@ -57,6 +57,8 @@ def build_worker_registration(ctx) -> WorkflowDefinition:
             worker_type=wtype,
             page_size=info.get("page_size") or None,
             dp_size=info.get("dp_size") or 1,
+            bootstrap_host=data.get("bootstrap_host"),
+            bootstrap_port=data.get("bootstrap_port"),
         )
         ctx.registry.add(worker)
         data["worker_id"] = worker.worker_id

@@ -150,6 +150,32 @@ class Router:
         policy = self.policies.policy_for(model_id)
         return policy.select_worker(workers, ctx or RequestContext(model_id=model_id))
 
+    def select_pd_http_pair(
+        self, model_id: str | None, ctx: RequestContext | None = None
+    ) -> "tuple[Worker, Worker] | None":
+        """(prefill, decode) pair among HTTP proxy-mode workers — non-None
+        means PD-over-HTTP dual dispatch (reference:
+        ``routers/http/pd_router.rs``: bootstrap injection + dual send)."""
+        from smg_tpu.gateway.workers import WorkerType
+
+        http = [
+            w for w in self._candidate_workers(model_id)
+            if getattr(w.client, "proxy_mode", False)
+        ]
+        prefills = [w for w in http if w.worker_type is WorkerType.PREFILL]
+        decodes = [w for w in http if w.worker_type is WorkerType.DECODE]
+        if not prefills or not decodes:
+            return None
+        policy = self.policies.policy_for(model_id)
+        rc = ctx or RequestContext(model_id=model_id)
+        p = policy.select_worker(prefills, rc)
+        d = policy.select_worker(decodes, rc)
+        if p is None or d is None:
+            # a pool exists but nothing in it is selectable right now
+            # (circuit open / draining): fall through to the other paths
+            return None
+        return p, d
+
     def _pd_pools(self, model_id: str | None):
         """(prefill_pool, decode_pool) — non-empty pair means PD mode
         (reference: RoutingMode::PrefillDecode, worker_selection.rs:28-36)."""

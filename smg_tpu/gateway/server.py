@@ -558,6 +558,12 @@ async def h_chat(request: web.Request) -> web.Response | web.StreamResponse:
     if adapter is not None:
         return await _chat_via_provider(request, ctx, adapter, req)
     router = ctx.router_for(req.model)
+    pd_pair = router.select_pd_http_pair(req.model)
+    if pd_pair is not None:
+        body = req.model_dump(exclude_none=True, exclude_unset=True)
+        return await _proxy_pd_via_http(
+            request, ctx, pd_pair, body, "/v1/chat/completions", req.stream
+        )
     proxy_worker = router.select_proxy_worker(req.model)
     if proxy_worker is not None:
         return await _proxy_via_http_worker(
@@ -618,14 +624,133 @@ async def _proxy_via_http_worker(
     """HTTP engine-worker proxy path (reference: ``routers/http/router.rs``):
     text-level passthrough to an OpenAI-compatible worker, with registry
     citizenship — load guard, circuit breaker feedback, worker metrics."""
+    body = req.model_dump(exclude_none=True, exclude_unset=True)
+    return await _proxy_raw_via_http_worker(
+        request, ctx, worker, body, path, bool(req.stream)
+    )
+
+
+def _inject_bootstrap(body: dict, prefill_worker) -> dict:
+    """PD-over-HTTP bootstrap metadata (reference: ``pd_router.rs``
+    ``inject_bootstrap_into_value``): both legs get the PREFILL worker's
+    rendezvous address plus a shared random room id; the engines transfer
+    the KV between themselves.  Batch requests (list text/input_ids on
+    /generate) get per-item lists."""
+    import random
+    from urllib.parse import urlparse
+
+    parsed = urlparse(prefill_worker.url if "//" in prefill_worker.url
+                      else "http://" + prefill_worker.url)
+    host = prefill_worker.bootstrap_host or parsed.hostname or prefill_worker.url
+    # port fallback mirrors host: a PREFILL worker registered without an
+    # explicit bootstrap_port rendezvouses on its serving port
+    port = prefill_worker.bootstrap_port
+    if port is None:
+        port = parsed.port
+    n = 1
+    for key in ("text", "input_ids", "prompt"):
+        v = body.get(key)
+        if isinstance(v, list) and v and isinstance(v[0], (str, list)):
+            n = len(v)
+            break
+    if n > 1:
+        rooms = [random.getrandbits(63) for _ in range(n)]
+        body["bootstrap_host"] = [host] * n
+        body["bootstrap_port"] = [port] * n
+        body["bootstrap_room"] = rooms
+    else:
+        body["bootstrap_host"] = host
+        body["bootstrap_port"] = port
+        body["bootstrap_room"] = random.getrandbits(63)
+    return body
+
+
+async def _proxy_pd_via_http(
+    request, ctx, pair, body: dict, path: str, stream: bool
+) -> web.Response | web.StreamResponse:
+    """PD-over-HTTP dual dispatch (reference: ``routers/http/pd_router.rs``
+    ``execute_dual_dispatch``): inject bootstrap metadata, send the request
+    to BOTH the prefill and the decode worker, return the decode worker's
+    response (the prefill leg's output is drained and only checked for
+    errors — its job is producing the KV the decode leg pulls)."""
+    import asyncio as _asyncio
+
     from smg_tpu.gateway.http_worker import HttpWorkerError
 
-    body = req.model_dump(exclude_none=True, exclude_unset=True)
+    prefill_w, decode_w = pair
+    body = _inject_bootstrap(dict(body), prefill_w)
+    prefill_body = {**body, "stream": False}
+    async with ctx.semaphore:
+        pguard = prefill_w.acquire()
+        dguard = decode_w.acquire()
+        p_ok = d_ok = False
+        prefill_task = _asyncio.create_task(
+            prefill_w.client.post_json(path, prefill_body)
+        )
+        try:
+            if not stream:
+                decode_task = _asyncio.create_task(
+                    decode_w.client.post_json(path, body)
+                )
+                p_res, d_res = await _asyncio.gather(
+                    prefill_task, decode_task, return_exceptions=True
+                )
+                if isinstance(p_res, BaseException):
+                    logger.warning("pd-http prefill leg failed: %s", p_res)
+                else:
+                    p_ok = True
+                if isinstance(d_res, BaseException):
+                    msg = getattr(d_res, "message", str(d_res))
+                    status = getattr(d_res, "status", 502)
+                    return _error(502 if status >= 500 else status,
+                                  f"worker error: {msg}", "worker_error")
+                d_ok = True
+                return web.json_response(d_res)
+            sse = _sse_response(request)
+            await sse.prepare(request)
+            try:
+                async for chunk in decode_w.client.stream_sse(path, body):
+                    await sse.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                await sse.write(b"data: [DONE]\n\n")
+                d_ok = True
+            except (ConnectionResetError, _asyncio.CancelledError):
+                # client hung up mid-stream: not a WORKER failure — don't
+                # feed the circuit breakers (gRPC-path convention)
+                p_ok = d_ok = True
+                raise
+            except (HttpWorkerError, Exception) as e:
+                msg = getattr(e, "message", str(e))
+                err = ErrorResponse(error=ErrorInfo(message=msg, type="worker_error"))
+                try:
+                    await sse.write(f"data: {json.dumps(err.model_dump())}\n\n".encode())
+                except ConnectionResetError:
+                    p_ok = d_ok = True
+            try:
+                await prefill_task
+                p_ok = True
+            except Exception as e:
+                logger.warning("pd-http prefill leg failed: %s", e)
+            await sse.write_eof()
+            return sse
+        finally:
+            if not prefill_task.done():
+                prefill_task.cancel()
+            pguard.release(success=p_ok)
+            dguard.release(success=d_ok)
+
+
+async def _proxy_raw_via_http_worker(
+    request, ctx, worker, body: dict, path: str, stream: bool
+) -> web.Response | web.StreamResponse:
+    """Raw-dict variant of ``_proxy_via_http_worker`` for native engine
+    endpoints (/generate) whose body isn't an OpenAI model object."""
+    from smg_tpu.gateway.http_worker import HttpWorkerError
+
     async with ctx.semaphore:
         guard = worker.acquire()
         ok = False
         try:
-            if not req.stream:
+            if not stream:
                 try:
                     data = await worker.client.post_json(path, body)
                 except HttpWorkerError as e:
@@ -660,6 +785,12 @@ async def h_completions(request: web.Request) -> web.Response | web.StreamRespon
         return _error(400, f"invalid request: {e}")
     rid = request["request_id"]
     router = ctx.router_for(req.model)
+    pd_pair = router.select_pd_http_pair(req.model)
+    if pd_pair is not None:
+        body = req.model_dump(exclude_none=True, exclude_unset=True)
+        return await _proxy_pd_via_http(
+            request, ctx, pd_pair, body, "/v1/completions", bool(req.stream)
+        )
     proxy_worker = router.select_proxy_worker(req.model)
     if proxy_worker is not None:
         return await _proxy_via_http_worker(
@@ -687,10 +818,25 @@ async def h_generate(request: web.Request) -> web.Response | web.StreamResponse:
     """SGLang-compatible native generate endpoint."""
     ctx: AppContext = request.app["ctx"]
     try:
-        req = GenerateRequest.model_validate(await request.json())
+        raw_body = await request.json()
+        req = GenerateRequest.model_validate(raw_body)
     except Exception as e:
         return _error(400, f"invalid request: {e}")
     rid = req.rid or request["request_id"]
+    # HTTP engine workers own /generate natively: raw passthrough (PD dual
+    # dispatch when prefill/decode pools exist — pd_router.rs parity)
+    router0 = ctx.router_for(None)
+    pd_pair = router0.select_pd_http_pair(None)
+    if pd_pair is not None:
+        return await _proxy_pd_via_http(
+            request, ctx, pd_pair, dict(raw_body), "/generate", bool(req.stream)
+        )
+    proxy_worker = router0.select_proxy_worker(None)
+    if proxy_worker is not None:
+        return await _proxy_raw_via_http_worker(
+            request, ctx, proxy_worker, dict(raw_body), "/generate",
+            bool(req.stream),
+        )
     sampling = req.to_sampling_params(ctx.router.config.default_max_tokens)
     if sampling.regex or sampling.ebnf:
         return _error(400, "regex/ebnf constrained decoding is not supported yet")
@@ -1341,6 +1487,8 @@ async def h_workers_add(request: web.Request) -> web.Response:
         "model_id": body.get("model_id"),
         "api_key": body.get("api_key", ""),
         "worker_type": body.get("worker_type"),
+        "bootstrap_host": body.get("bootstrap_host"),
+        "bootstrap_port": body.get("bootstrap_port"),
         "skip_tokenizer": bool(body.get("skip_tokenizer")),
     }
 

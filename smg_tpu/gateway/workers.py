@@ -102,12 +102,19 @@ class Worker:
         priority: int = 0,
         page_size: int | None = None,
         dp_size: int = 1,
+        bootstrap_host: str | None = None,
+        bootstrap_port: int | None = None,
     ):
         self.worker_id = worker_id
         self.client = client
         self.model_id = model_id
         self.worker_type = worker_type
         self.url = url or worker_id
+        # PD-over-HTTP rendezvous endpoint on a PREFILL worker (reference:
+        # pd_router.rs bootstrap_host/bootstrap_port): the engines transfer
+        # KV between themselves; the gateway only injects the address
+        self.bootstrap_host = bootstrap_host
+        self.bootstrap_port = bootstrap_port
         self.priority = priority
         self.page_size = page_size  # engine KV page size (cache_aware event mode)
         self.dp_size = max(int(dp_size), 1)  # DP engine replicas behind this worker

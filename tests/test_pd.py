@@ -3,6 +3,7 @@ reproduce single-engine outputs exactly (reference: PD routing mode +
 NIXL/Mooncake connectors, SURVEY.md §2.5)."""
 
 import asyncio
+import json
 import threading
 
 import pytest
@@ -362,3 +363,185 @@ def test_transfer_offer_lifecycle():
         time.sleep(0.05)
     assert not any(t.name.startswith("kv-reclaim") and t.is_alive()
                    for t in __import__("threading").enumerate())
+
+
+# ---- PD over HTTP workers (r5: pd_router.rs parity) ----
+
+
+def _make_pd_http_worker(seen: list, role: str, model_id: str = "pd-http-model"):
+    """OpenAI-wire engine worker that records the bootstrap metadata the
+    gateway injected (the real engines use it to rendezvous KV transfer)."""
+    import json as _json
+
+    from aiohttp import web
+
+    async def models(request):
+        return web.json_response({"object": "list", "data": [{"id": model_id}]})
+
+    async def health(request):
+        return web.Response(text="ok")
+
+    async def chat(request):
+        body = await request.json()
+        seen.append({"role": role, "path": "/v1/chat/completions", "body": body})
+        if body.get("stream"):
+            resp = web.StreamResponse(headers={"content-type": "text/event-stream"})
+            await resp.prepare(request)
+            for frag in (f"{role} ", "stream"):
+                f = {"id": "c1", "object": "chat.completion.chunk",
+                     "choices": [{"index": 0, "delta": {"content": frag}}]}
+                await resp.write(f"data: {_json.dumps(f)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response({
+            "id": "c1", "object": "chat.completion", "created": 1,
+            "model": body.get("model"),
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": f"{role} answer"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 2, "completion_tokens": 2, "total_tokens": 4},
+        })
+
+    async def generate(request):
+        body = await request.json()
+        seen.append({"role": role, "path": "/generate", "body": body})
+        return web.json_response({
+            "text": f"{role} generated", "output_ids": [1, 2],
+            "meta_info": {"id": body.get("rid") or "g1",
+                          "finish_reason": {"type": "stop"}},
+        })
+
+    from aiohttp import web as _web
+
+    app = _web.Application()
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/health", health)
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_post("/generate", generate)
+    return app
+
+
+@pytest.fixture(scope="module")
+def pd_http_gateway():
+    import threading
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from smg_tpu.gateway.server import AppContext, build_app
+
+    loop = asyncio.new_event_loop()
+    seen: list = []
+    ctx = AppContext(policy="round_robin")
+
+    async def _setup():
+        servers = []
+        for role in ("prefill", "decode"):
+            s = TestServer(_make_pd_http_worker(seen, role))
+            await s.start_server()
+            servers.append((role, s))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        for role, s in servers:
+            url = str(s.make_url("")).rstrip("/")
+            r = await tc.post("/workers", json={
+                "url": url, "worker_type": role,
+                "bootstrap_host": "10.0.0.7" if role == "prefill" else None,
+                "bootstrap_port": 8998 if role == "prefill" else None,
+            })
+            assert r.status == 200, await r.text()
+        return tc, [s for _, s in servers]
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=60)
+
+    tc, servers = run(_setup())
+
+    class H:
+        pass
+
+    h = H()
+    h.run, h.client, h.seen = run, tc, seen
+    yield h
+    run(tc.close())
+    for s in servers:
+        run(s.close())
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_pd_http_chat_dual_dispatch(pd_http_gateway):
+    """Chat over HTTP PD: both legs receive the request with IDENTICAL
+    bootstrap metadata (prefill worker's host/port + shared random room);
+    the client sees the decode leg's answer."""
+    h = pd_http_gateway
+    h.seen.clear()
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "pd-http-model",
+            "messages": [{"role": "user", "content": "hi"}],
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["choices"][0]["message"]["content"] == "decode answer"
+    roles = sorted(s["role"] for s in h.seen)
+    assert roles == ["decode", "prefill"]
+    p = next(s["body"] for s in h.seen if s["role"] == "prefill")
+    d = next(s["body"] for s in h.seen if s["role"] == "decode")
+    assert p["bootstrap_host"] == d["bootstrap_host"] == "10.0.0.7"
+    assert p["bootstrap_port"] == d["bootstrap_port"] == 8998
+    assert p["bootstrap_room"] == d["bootstrap_room"]
+    assert isinstance(p["bootstrap_room"], int)
+    # the prefill leg is forced non-streaming
+    assert p["stream"] is False
+
+
+def test_pd_http_chat_streaming_from_decode(pd_http_gateway):
+    h = pd_http_gateway
+    h.seen.clear()
+
+    async def go():
+        r = await h.client.post("/v1/chat/completions", json={
+            "model": "pd-http-model", "stream": True,
+            "messages": [{"role": "user", "content": "hi"}],
+        })
+        return await r.text()
+
+    raw = h.run(go())
+    text = "".join(
+        (json.loads(l[6:])["choices"][0]["delta"].get("content") or "")
+        for l in raw.splitlines()
+        if l.startswith("data: ") and l != "data: [DONE]"
+        and json.loads(l[6:]).get("choices")
+    )
+    assert text == "decode stream"
+    p = next(s["body"] for s in h.seen if s["role"] == "prefill")
+    assert p["stream"] is False  # prefill leg never streams
+
+
+def test_pd_http_generate_passthrough(pd_http_gateway):
+    """/generate passthrough parity: raw body forwarded to both legs with
+    bootstrap metadata, decode's native response returned."""
+    h = pd_http_gateway
+    h.seen.clear()
+
+    async def go():
+        r = await h.client.post("/generate", json={
+            "text": "complete this", "sampling_params": {"max_new_tokens": 4},
+        })
+        return r.status, await r.json()
+
+    status, body = h.run(go())
+    assert status == 200, body
+    assert body["text"] == "decode generated"
+    gen = [s for s in h.seen if s["path"] == "/generate"]
+    assert sorted(s["role"] for s in gen) == ["decode", "prefill"]
+    p = next(s["body"] for s in gen if s["role"] == "prefill")
+    d = next(s["body"] for s in gen if s["role"] == "decode")
+    assert p["bootstrap_room"] == d["bootstrap_room"]
+    assert p["text"] == "complete this"  # raw body passthrough
