@@ -14,6 +14,11 @@ from smg_tpu.utils import get_logger
 
 logger = get_logger("constrained")
 
+# piece tables depend only on (tokenizer, vocab_size) — shared across every
+# filter (the engine keys filters per grammar PATTERN, and rebuilding a
+# vocab-size decode table per pattern would duplicate work and memory)
+_piece_tables: dict[tuple, list] = {}
+
 
 class TokenFilter:
     def __init__(self, tokenizer, machine, vocab_size: int, eos_token_ids=()):
@@ -21,16 +26,20 @@ class TokenFilter:
         self.machine = machine
         self.vocab_size = vocab_size
         self.eos_ids = set(eos_token_ids)
-        self._pieces: list[str] | None = None
         self._mask_cache: dict[str, np.ndarray] = {}
 
     def _piece_table(self) -> list[str]:
-        if self._pieces is None:
-            self._pieces = [
+        key = (id(self.tok), self.vocab_size)
+        pieces = _piece_tables.get(key)
+        if pieces is None:
+            pieces = [
                 self.tok.decode([t], skip_special_tokens=False)
                 for t in range(self.vocab_size)
             ]
-        return self._pieces
+            if len(_piece_tables) >= 8:  # a handful of live tokenizers
+                _piece_tables.pop(next(iter(_piece_tables)))
+            _piece_tables[key] = pieces
+        return pieces
 
     def allowed_mask(self, text_so_far: str) -> np.ndarray:
         """Boolean [vocab] mask of tokens that keep the output prefix-valid.

@@ -106,6 +106,7 @@ class Engine:
                 )(vkey)
         self._callbacks: dict[str, object] = {}
         self._json_filter = None  # shared TokenFilter (piece table + mask cache)
+        self._grammar_filters: dict = {}  # (kind, pattern) -> TokenFilter
         self._lock = threading.RLock()
         self._wakeup = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
@@ -187,11 +188,34 @@ class Engine:
         behind ``sglang_scheduler.proto`` SamplingParams."""
         if sampling.json_schema is None and not sampling.regex and not sampling.ebnf:
             return None
-        if sampling.regex or sampling.ebnf:
-            raise ValueError("regex/ebnf constrained decoding is not supported yet")
         if self.tokenizer is None:
-            logger.warning("json_schema constraint ignored: engine has no tokenizer")
+            logger.warning("grammar constraint ignored: engine has no tokenizer")
             return None
+        if sampling.regex or sampling.ebnf:
+            # pattern/grammar-specific acceptors share one filter per
+            # pattern (piece table + mask cache are pattern-keyed)
+            from smg_tpu.constrained import TokenFilter
+
+            key = ("ebnf", sampling.ebnf) if sampling.ebnf else ("regex", sampling.regex)
+            cached = self._grammar_filters.get(key)
+            if cached is not None:
+                return cached
+            if sampling.ebnf:
+                from smg_tpu.constrained.ebnf import EbnfMachine
+
+                machine = EbnfMachine(sampling.ebnf)
+            else:
+                from smg_tpu.constrained.regex_fsm import RegexMachine
+
+                machine = RegexMachine(sampling.regex)
+            filt = TokenFilter(
+                self.tokenizer, machine, self.config.model.vocab_size,
+                eos_token_ids=self.config.model.eos_token_ids,
+            )
+            if len(self._grammar_filters) >= 16:  # bound pattern-keyed mask caches
+                self._grammar_filters.pop(next(iter(self._grammar_filters)))
+            self._grammar_filters[key] = filt
+            return filt
         if self._json_filter is None:
             from smg_tpu.constrained import JsonMachine, TokenFilter
 

@@ -1,9 +1,13 @@
-"""Auth: API keys with roles, optional HMAC-signed bearer tokens, audit log.
+"""Auth: API keys with roles, HMAC- and RSA-signed bearer tokens, audit log.
 
-Reference: ``crates/auth`` (smg-auth) — control-plane JWT/OIDC + API keys with
-roles + audit (SURVEY.md §2.2).  JWKS/OIDC discovery needs egress, so the
-in-tree verifier covers API keys and HS256 JWTs; the middleware seam matches
-the reference so an OIDC verifier can slot in.
+Reference: ``crates/auth`` (smg-auth, ``src/lib.rs:1-20``) — control-plane
+JWT/OIDC + API keys with roles + audit (SURVEY.md §2.2).  OIDC/JWKS (r5):
+RS256 verification against a JWKS document through an INJECTABLE fetcher —
+discovery needs egress, so deployments hand the verifier a callable that
+reads ``{issuer}/.well-known/jwks.json`` (and tests hand it fakes); key
+rotation is handled by one forced refresh on an unknown ``kid``.  The RSA
+signature check is pure Python (modular exponentiation + PKCS1-v1_5
+padding) — no crypto-library dependency at runtime.
 """
 
 from __future__ import annotations
@@ -32,6 +36,7 @@ class AuthConfig:
     enabled: bool = False
     api_keys: dict[str, Principal] = field(default_factory=dict)  # key -> principal
     jwt_secret: str | None = None  # enables HS256 bearer verification
+    jwks: "JwksVerifier | None" = None  # enables RS256/OIDC bearer verification
     # routes that skip auth (probes)
     public_paths: tuple[str, ...] = ("/health", "/liveness", "/readiness", "/metrics")
 
@@ -73,6 +78,122 @@ def verify_hs256(token: str, secret: str) -> dict:
     return payload
 
 
+# ---- RS256 / JWKS (OIDC) ----
+
+#: DER DigestInfo prefix for SHA-256 (RFC 8017 §9.2 notes)
+_SHA256_DIGEST_INFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420"
+)
+
+
+def _rsa_pkcs1_verify(signing_input: bytes, sig: bytes, n: int, e: int) -> bool:
+    """RSASSA-PKCS1-v1_5 / SHA-256 verification by modular exponentiation."""
+    k = (n.bit_length() + 7) // 8
+    if len(sig) != k:
+        return False
+    m = pow(int.from_bytes(sig, "big"), e, n)
+    em = m.to_bytes(k, "big")
+    digest = hashlib.sha256(signing_input).digest()
+    expected = (
+        b"\x00\x01"
+        + b"\xff" * (k - 3 - len(_SHA256_DIGEST_INFO) - len(digest))
+        + b"\x00" + _SHA256_DIGEST_INFO + digest
+    )
+    return hmac.compare_digest(em, expected)
+
+
+class JwksVerifier:
+    """RS256 bearer verification against a JWKS document.
+
+    ``fetcher`` is a zero-arg callable returning the parsed JWKS dict
+    (``{"keys": [{"kty": "RSA", "kid": ..., "n": ..., "e": ...}, ...]}``).
+    Keys cache for ``cache_ttl`` seconds; an unknown ``kid`` forces ONE
+    refresh (standard IdP key rotation) before failing."""
+
+    def __init__(self, fetcher, issuer: str | None = None,
+                 audience: str | None = None, cache_ttl: float = 300.0,
+                 min_refresh_interval: float = 10.0):
+        self.fetcher = fetcher
+        self.issuer = issuer
+        self.audience = audience
+        self.cache_ttl = cache_ttl
+        # rotation-refresh cooldown: unauthenticated garbage kids must not
+        # turn every request into a blocking IdP fetch
+        self.min_refresh_interval = min_refresh_interval
+        self._keys: dict[str, tuple[int, int]] = {}
+        self._fetched_at = 0.0
+
+    def _refresh(self) -> None:
+        doc = self.fetcher()
+        keys: dict[str, tuple[int, int]] = {}
+        for jwk in (doc or {}).get("keys", []):
+            if jwk.get("kty") != "RSA" or "n" not in jwk or "e" not in jwk:
+                continue
+            n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+            e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+            keys[jwk.get("kid", "")] = (n, e)
+        self._keys = keys
+        self._fetched_at = time.monotonic()
+
+    def _key_for(self, kid: str) -> "tuple[int, int] | None":
+        if not self._keys or time.monotonic() - self._fetched_at > self.cache_ttl:
+            try:
+                self._refresh()
+            except Exception as e:
+                logger.warning("JWKS fetch failed: %s", e)
+        if kid not in self._keys and (
+            time.monotonic() - self._fetched_at > self.min_refresh_interval
+        ):
+            # rotation: the IdP may have published a new key since our cache
+            try:
+                self._refresh()
+            except Exception as e:
+                logger.warning("JWKS refresh failed: %s", e)
+        return self._keys.get(kid)
+
+    def verify(self, token: str) -> dict:
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+            header = json.loads(_b64url_decode(header_b64))
+        except Exception:
+            raise AuthError("malformed token")
+        if header.get("alg") != "RS256":
+            raise AuthError(f"unsupported alg {header.get('alg')}")
+        key = self._key_for(header.get("kid", ""))
+        if key is None:
+            raise AuthError("unknown key id")
+        try:
+            sig = _b64url_decode(sig_b64)
+            payload = json.loads(_b64url_decode(payload_b64))
+        except Exception:
+            raise AuthError("malformed token")
+        if not _rsa_pkcs1_verify(
+            f"{header_b64}.{payload_b64}".encode(), sig, key[0], key[1]
+        ):
+            raise AuthError("bad signature")
+        if "exp" in payload and payload["exp"] < time.time():
+            raise AuthError("token expired")
+        if self.issuer is not None and payload.get("iss") != self.issuer:
+            raise AuthError("wrong issuer", 403)
+        if self.audience is not None:
+            aud = payload.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise AuthError("wrong audience", 403)
+        return payload
+
+
+def _jwt_alg(token: str) -> str | None:
+    """Peek a bearer token's JOSE header alg (None = not a JWT)."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        return None
+    try:
+        return json.loads(_b64url_decode(parts[0])).get("alg")
+    except Exception:
+        return None
+
+
 class Authenticator:
     def __init__(self, config: AuthConfig):
         self.config = config
@@ -90,13 +211,19 @@ class Authenticator:
         if not api_key:
             raise AuthError("missing credentials")
         principal = self.config.api_keys.get(api_key)
-        if principal is None and self.config.jwt_secret:
-            payload = verify_hs256(api_key, self.config.jwt_secret)
-            principal = Principal(
-                id=str(payload.get("sub", "jwt-user")),
-                roles=tuple(payload.get("roles", ["user"])),
-                tenant=str(payload.get("tenant", "default")),
-            )
+        if principal is None:
+            alg = _jwt_alg(api_key)
+            payload = None
+            if alg == "RS256" and self.config.jwks is not None:
+                payload = self.config.jwks.verify(api_key)
+            elif alg == "HS256" and self.config.jwt_secret:
+                payload = verify_hs256(api_key, self.config.jwt_secret)
+            if payload is not None:
+                principal = Principal(
+                    id=str(payload.get("sub", "jwt-user")),
+                    roles=tuple(payload.get("roles", ["user"])),
+                    tenant=str(payload.get("tenant", "default")),
+                )
         if principal is None:
             self._audit("denied", path, None)
             raise AuthError("invalid credentials", 403)

@@ -246,6 +246,15 @@ class Router:
     ):
         """Async generator of StreamEvent with retry-on-dispatch-failure.
         ``mm`` = (embeds, positions) vision splice riding the dispatch."""
+        if sampling.regex or sampling.ebnf:
+            # malformed patterns are a client error at the front door, not
+            # a retried 502 when a worker's submit raises
+            from smg_tpu.constrained import validate_grammar
+
+            try:
+                validate_grammar(sampling.regex, sampling.ebnf)
+            except ValueError as e:
+                raise RouteError(400, f"invalid grammar: {e}")
         # stop strings are enforced gateway-side; worker gets token-level params
         worker_sampling = SamplingParams(**{**sampling.__dict__, "stop": []})
         stop_checker = StopStringChecker(sampling.stop) if sampling.stop else None

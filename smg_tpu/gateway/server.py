@@ -838,8 +838,6 @@ async def h_generate(request: web.Request) -> web.Response | web.StreamResponse:
             bool(req.stream),
         )
     sampling = req.to_sampling_params(ctx.router.config.default_max_tokens)
-    if sampling.regex or sampling.ebnf:
-        return _error(400, "regex/ebnf constrained decoding is not supported yet")
 
     if isinstance(req.text, list) or (req.input_ids and isinstance(req.input_ids[0], list)):
         return _error(400, "batch generate not yet supported; send one prompt per request")
