@@ -332,6 +332,20 @@ if __name__ == "__main__":
     # TPU unavailable or the TPU run failed: say so — the CPU smoke is a
     # diagnostic embedded in the record, never the headline metric.
     smoke = _run_child("cpu", timeout_s=900)
+    # deterministic engine gate (fixed seeds + stream fingerprint): the
+    # round-over-round regression record while the TPU stays unreachable
+    gate = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(_repo_root(), "benches", "bench_engine.py")],
+            env=_sanitized_env(), cwd=_repo_root(), timeout=900,
+            stdout=subprocess.PIPE, text=True,
+        )
+        gate = _salvage_result(r.stdout) or (
+            json.loads(r.stdout.strip().splitlines()[-1]) if r.stdout.strip() else None
+        )
+    except Exception as e:
+        gate = {"error": f"{type(e).__name__}: {e}"[:200]}
     print(json.dumps({
         "metric": "tpu_unavailable",
         "value": 0.0,
@@ -340,5 +354,6 @@ if __name__ == "__main__":
         "detail": "TPU backend failed to initialize (probe retried ~6min) "
                   "or the TPU bench child produced no result",
         "cpu_smoke": smoke,
+        "engine_gate": gate,
     }))
     sys.exit(1)

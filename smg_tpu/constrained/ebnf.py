@@ -198,53 +198,70 @@ class EbnfMachine:
                         raise GrammarError(f"undefined rule {val!r}")
 
     # Earley item: (rule, alt_index, dot, origin)
-    def _chart(self, text: str):
+
+    def _process(self, read, items: set, pos: int, char, scanned: set) -> None:
+        """Run one chart position to fixpoint: predict/complete within
+        ``items``, scan ``char`` (None at end-of-input) into ``scanned``.
+        ``read(origin)`` resolves earlier positions' item sets (read-only —
+        lets incremental extension share the immutable prefix chart)."""
         rules = self.rules
+        queue = list(items)
+        while queue:
+            rule, ai, dot, origin = queue.pop()
+            alt = rules[rule][ai]
+            if dot < len(alt):
+                kind, val = alt[dot]
+                if kind == "r":
+                    for bi in range(len(rules[val])):
+                        cand = (val, bi, 0, pos)
+                        if cand not in items:
+                            items.add(cand)
+                            queue.append(cand)
+                    # magic completion for nullable rules: if val already
+                    # completed at pos, advance past it
+                    for other in list(items):
+                        if (other[0] == val and other[3] == pos
+                                and other[2] == len(rules[val][other[1]])):
+                            cand = (rule, ai, dot + 1, origin)
+                            if cand not in items:
+                                items.add(cand)
+                                queue.append(cand)
+                elif kind == "t" and char is not None and val(char):
+                    scanned.add((rule, ai, dot + 1, origin))
+            else:
+                # complete: advance every item waiting on `rule` at origin
+                src = items if origin == pos else read(origin)
+                for other in list(src):
+                    orule, oai, odot, oorigin = other
+                    oalt = rules[orule][oai]
+                    if odot < len(oalt) and oalt[odot] == ("r", rule):
+                        cand = (orule, oai, odot + 1, oorigin)
+                        if cand not in items:
+                            items.add(cand)
+                            queue.append(cand)
+
+    def _chart(self, text: str):
         n = len(text)
         chart: list[set] = [set() for _ in range(n + 1)]
-        for ai in range(len(rules["root"])):
+        for ai in range(len(self.rules["root"])):
             chart[0].add(("root", ai, 0, 0))
+        read = lambda origin: chart[origin]  # noqa: E731
         for pos in range(n + 1):
-            items = chart[pos]
-            queue = list(items)
-            while queue:
-                item = queue.pop()
-                rule, ai, dot, origin = item
-                alt = rules[rule][ai]
-                if dot < len(alt):
-                    kind, val = alt[dot]
-                    if kind == "r":
-                        # predict
-                        for bi in range(len(rules[val])):
-                            cand = (val, bi, 0, pos)
-                            if cand not in items:
-                                items.add(cand)
-                                queue.append(cand)
-                        # magic completion for nullable rules: if val can
-                        # complete at pos (already in this chart as done),
-                        # advance past it
-                        for other in list(items):
-                            if (other[0] == val and other[3] == pos
-                                    and other[2] == len(rules[val][other[1]])):
-                                cand = (rule, ai, dot + 1, origin)
-                                if cand not in items:
-                                    items.add(cand)
-                                    queue.append(cand)
-                    elif kind == "t" and pos < n and val(text[pos]):
-                        chart[pos + 1].add((rule, ai, dot + 1, origin))
-                else:
-                    # complete: advance every item waiting on `rule` at origin
-                    for other in list(chart[origin]):
-                        orule, oai, odot, oorigin = other
-                        oalt = rules[orule][oai]
-                        if odot < len(oalt) and oalt[odot] == ("r", rule):
-                            cand = (orule, oai, odot + 1, oorigin)
-                            if cand not in items:
-                                items.add(cand)
-                                queue.append(cand)
-            if pos < n and not chart[pos + 1]:
-                return chart, pos + 1  # scan failed at pos+1
+            scanned: set = set()
+            self._process(read, chart[pos], pos,
+                          text[pos] if pos < n else None, scanned)
+            if pos < n:
+                chart[pos + 1] |= scanned
+                if not chart[pos + 1]:
+                    return chart, pos + 1  # scan failed
         return chart, None
+
+    @staticmethod
+    def _root_done(items, rules) -> bool:
+        return any(
+            rule == "root" and origin == 0 and dot == len(rules["root"][ai])
+            for rule, ai, dot, origin in items
+        )
 
     def accepts(self, text: str) -> bool:
         _, failed_at = self._chart(text)
@@ -254,8 +271,40 @@ class EbnfMachine:
         chart, failed_at = self._chart(text)
         if failed_at is not None:
             return False
-        return any(
-            rule == "root" and origin == 0
-            and dot == len(self.rules["root"][ai])
-            for rule, ai, dot, origin in chart[len(text)]
-        )
+        return self._root_done(chart[len(text)], self.rules)
+
+    # ---- incremental interface (TokenFilter fast path): the prefix chart
+    # computes ONCE per decode step; each candidate piece extends a COPY of
+    # the frontier set, sharing positions < n read-only ----
+
+    def prefix_state(self, text: str):
+        chart, failed_at = self._chart(text)
+        return None if failed_at is not None else chart
+
+    def accepts_from(self, chart, piece: str) -> bool:
+        return self._extend(chart, piece) is not None
+
+    def complete_from(self, chart) -> bool:
+        # the frontier was already processed to fixpoint by _chart
+        return self._root_done(chart[len(chart) - 1], self.rules)
+
+    def _extend(self, chart, piece: str):
+        """Extend a prefix chart by ``piece`` without mutating it; returns
+        the list of NEW position sets (frontier copy first) or None when
+        the scan dies."""
+        base = len(chart) - 1
+        new_sets: list[set] = [set(chart[base])]
+
+        def read(origin):
+            return chart[origin] if origin < base else new_sets[origin - base]
+
+        for k in range(len(piece) + 1):
+            char = piece[k] if k < len(piece) else None
+            pos = base + k
+            scanned: set = set()
+            self._process(read, new_sets[k], pos, char, scanned)
+            if char is not None:
+                if not scanned:
+                    return None
+                new_sets.append(scanned)
+        return new_sets

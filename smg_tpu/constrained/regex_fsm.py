@@ -132,7 +132,9 @@ class _Parser:
             return ("char", self._char_class())
         if c == "\\":
             self.i += 1
-            return ("char", self._escape(self.p[self.i - 0]))
+            if self.i >= len(self.p):
+                raise ValueError("dangling escape at end of pattern")
+            return ("char", self._escape(self.p[self.i]))
         if c in ("^", "$"):  # anchors are implicit (full match); skip
             self.i += 1
             return ("cat", [])
@@ -165,8 +167,9 @@ class _Parser:
             c = self.peek()
             if c == "\\":
                 self.i += 1
-                esc = self.p[self.i]
-                sub = self._escape(esc)
+                if self.i >= len(self.p):
+                    raise ValueError("dangling escape in char class")
+                sub = self._escape(self.p[self.i])
                 if sub.chars is not None and not sub.negate:
                     chars |= sub.chars
                 else:
@@ -277,3 +280,24 @@ class RegexMachine:
 
     def complete(self, text: str) -> bool:
         return self._accept in self._run(text) if text else self._accept in self._closure({self._start})[0]
+
+    # ---- incremental interface (TokenFilter fast path): compute the NFA
+    # state ONCE per decode step, extend it per candidate piece — O(V·|piece|)
+    # instead of re-simulating the whole prefix V times ----
+
+    def prefix_state(self, text: str):
+        """Closed state set after ``text``; None = dead prefix."""
+        states = self._run(text) if text else self._closure({self._start})[0]
+        return states or None
+
+    def accepts_from(self, states, piece: str) -> bool:
+        cur = states
+        for c in piece:
+            closed, trans = self._closure(cur)
+            cur = {t for pred, t in trans if pred(c)}
+            if not cur:
+                return False
+        return True
+
+    def complete_from(self, states) -> bool:
+        return self._accept in states

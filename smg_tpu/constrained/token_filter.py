@@ -16,8 +16,10 @@ logger = get_logger("constrained")
 
 # piece tables depend only on (tokenizer, vocab_size) — shared across every
 # filter (the engine keys filters per grammar PATTERN, and rebuilding a
-# vocab-size decode table per pattern would duplicate work and memory)
-_piece_tables: dict[tuple, list] = {}
+# vocab-size decode table per pattern would duplicate work and memory).
+# Entries hold a STRONG reference to the tokenizer: keying by id() alone
+# would let a GC'd tokenizer's reused address serve another model's pieces.
+_piece_tables: dict[tuple, tuple] = {}  # (id, vocab) -> (tokenizer, pieces)
 
 
 class TokenFilter:
@@ -30,32 +32,49 @@ class TokenFilter:
 
     def _piece_table(self) -> list[str]:
         key = (id(self.tok), self.vocab_size)
-        pieces = _piece_tables.get(key)
-        if pieces is None:
-            pieces = [
-                self.tok.decode([t], skip_special_tokens=False)
-                for t in range(self.vocab_size)
-            ]
-            if len(_piece_tables) >= 8:  # a handful of live tokenizers
-                _piece_tables.pop(next(iter(_piece_tables)))
-            _piece_tables[key] = pieces
+        entry = _piece_tables.get(key)
+        if entry is not None and entry[0] is self.tok:
+            return entry[1]
+        pieces = [
+            self.tok.decode([t], skip_special_tokens=False)
+            for t in range(self.vocab_size)
+        ]
+        if len(_piece_tables) >= 8:  # a handful of live tokenizers
+            _piece_tables.pop(next(iter(_piece_tables)))
+        _piece_tables[key] = (self.tok, pieces)
         return pieces
 
     def allowed_mask(self, text_so_far: str) -> np.ndarray:
         """Boolean [vocab] mask of tokens that keep the output prefix-valid.
-        EOS allowed iff the document is already complete."""
+        EOS allowed iff the document is already complete.
+
+        Fast path: machines exposing the incremental interface
+        (``prefix_state``/``accepts_from``) simulate the n-char prefix ONCE
+        and extend per candidate piece — O(V·|piece|) instead of O(V·n)
+        (regex NFA) / O(V·n²) (EBNF Earley) per step."""
         cached = self._mask_cache.get(text_so_far)
         if cached is not None:
             return cached
         pieces = self._piece_table()
         mask = np.zeros(self.vocab_size, bool)
-        complete = self.machine.complete(text_so_far)
+        state = None
+        incremental = hasattr(self.machine, "prefix_state")
+        if incremental:
+            state = self.machine.prefix_state(text_so_far)
+            complete = state is not None and self.machine.complete_from(state)
+        else:
+            complete = self.machine.complete(text_so_far)
         for tid, piece in enumerate(pieces):
             if tid in self.eos_ids:
                 mask[tid] = complete
-            elif piece and self.machine.accepts(text_so_far + piece):
-                # once complete, only whitespace extensions remain valid
-                mask[tid] = True
+            elif piece:
+                if incremental:
+                    mask[tid] = state is not None and self.machine.accepts_from(
+                        state, piece
+                    )
+                else:
+                    # once complete, only whitespace extensions remain valid
+                    mask[tid] = self.machine.accepts(text_so_far + piece)
         if len(self._mask_cache) < 512:
             self._mask_cache[text_so_far] = mask
         return mask

@@ -206,7 +206,14 @@ class ModelRunner:
         gather+softmax wins (fused-lane layout makes the gather
         relayout-free); long contexts: the gather materializes B*mp*ps*KD
         bytes per layer and the page-streaming pallas kernel wins.
-        Crossover measured at ~100k gathered tokens (1B model, v5e)."""
+
+        PROVENANCE of the 131072-token crossover: one-off interactive
+        measurement on a v5e-1 during round-3 development (1B-class model,
+        bench.py's long-context A/B shape); NOT reproduced in any committed
+        BENCH artifact — the environment's TPU has been unreachable every
+        round (BENCH_r01..r04 ``tpu_unavailable``).  Treat as an estimate;
+        ``bench.py`` re-measures the A/B and should recalibrate this
+        threshold the first round a real TPU record lands."""
         if self.use_pp:
             return "xla"  # pallas kernels don't run inside the pp shard_map
         if self.attn_impl != "auto":

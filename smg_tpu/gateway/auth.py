@@ -121,9 +121,14 @@ class JwksVerifier:
         # turn every request into a blocking IdP fetch
         self.min_refresh_interval = min_refresh_interval
         self._keys: dict[str, tuple[int, int]] = {}
-        self._fetched_at = 0.0
+        self._fetched_at = 0.0  # last SUCCESSFUL fetch (TTL)
+        self._last_attempt = -1e9  # last fetch attempt incl. failures (cooldown)
 
     def _refresh(self) -> None:
+        # the attempt timestamp moves even on failure: an IdP outage must
+        # not turn every request (incl. garbage tokens) into blocking
+        # fetches — the cooldown negative-caches the failure
+        self._last_attempt = time.monotonic()
         doc = self.fetcher()
         keys: dict[str, tuple[int, int]] = {}
         for jwk in (doc or {}).get("keys", []):
@@ -135,15 +140,18 @@ class JwksVerifier:
         self._keys = keys
         self._fetched_at = time.monotonic()
 
+    def _cooled(self) -> bool:
+        return time.monotonic() - self._last_attempt > self.min_refresh_interval
+
     def _key_for(self, kid: str) -> "tuple[int, int] | None":
-        if not self._keys or time.monotonic() - self._fetched_at > self.cache_ttl:
+        now = time.monotonic()
+        stale = not self._keys or now - self._fetched_at > self.cache_ttl
+        if stale and self._cooled():
             try:
                 self._refresh()
             except Exception as e:
                 logger.warning("JWKS fetch failed: %s", e)
-        if kid not in self._keys and (
-            time.monotonic() - self._fetched_at > self.min_refresh_interval
-        ):
+        if kid not in self._keys and self._cooled():
             # rotation: the IdP may have published a new key since our cache
             try:
                 self._refresh()

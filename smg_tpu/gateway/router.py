@@ -613,10 +613,41 @@ class Router:
         )
         loop = asyncio.get_running_loop()
 
+        from smg_tpu.multimodal.pixel_cache import (
+            get_pixel_cache,
+            image_source_hash,
+            processor_fingerprint,
+        )
+
+        pixel_cache = get_pixel_cache()
+        proc_fp = processor_fingerprint(proc) if pixel_cache is not None else ""
+
         async def one_image(part, session):
+            cache_key = None
+            if pixel_cache is not None:
+                cache_key = (image_source_hash(part), proc_fp)
+                hit = pixel_cache.get(cache_key)
+                if hit is not None:
+                    # fetch/decode/preprocess skipped; the encode RPC still
+                    # runs (embeddings are worker-side state)
+                    pv, grid, n_tok, llm_grid = hit
+                    e = await worker.client.encode_image(pv, grid)
+                    if e.shape[0] != n_tok:
+                        raise RouteError(
+                            502,
+                            f"encode returned {e.shape[0]} embeddings for "
+                            f"{n_tok} placeholder tokens",
+                            "worker_error",
+                        )
+                    return np.asarray(e, np.float32), n_tok, llm_grid
             img = await fetch_image(part, http_session=session)
             # preprocessing is jax work — keep it off the event loop
             pimg = await loop.run_in_executor(None, proc.process, img)
+            if cache_key is not None:
+                pixel_cache.put(cache_key, (
+                    np.asarray(pimg.pixel_values, np.float32), pimg.grid,
+                    pimg.num_placeholder_tokens, pimg.llm_grid,
+                ))
             e = await worker.client.encode_image(
                 np.asarray(pimg.pixel_values, np.float32), pimg.grid
             )

@@ -230,6 +230,150 @@ class Phi3VisionImageProcessor(ImageProcessor):
         )
 
 
+class Llama4VisionProcessor(ImageProcessor):
+    """Llama 4: aspect-matched 336x336 tiling under a 16-tile budget plus a
+    global tile when tiled; 576 tokens per tile (336/14)^2, no merge
+    (reference: vision/processors/llama4_vision.rs — mean/std 0.5)."""
+
+    name = "llama4"
+
+    def __init__(self, tile_size: int = 336, patch_size: int = 14,
+                 max_tiles: int = 16):
+        self.tile_size = tile_size
+        self.patch_size = patch_size
+        self.max_tiles = max_tiles
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        H, W = img.shape[:2]
+        ratio = W / H
+        ts = self.tile_size
+        best, best_diff = (1, 1), float("inf")
+        for rows in range(1, self.max_tiles + 1):
+            for cols in range(1, self.max_tiles // rows + 1):
+                diff = abs(cols / rows - ratio)
+                # ratio ties (every square image ties at 0) resolve by
+                # RESOLUTION: use more tiles when the image has the pixels
+                # to fill them — otherwise a 1344x1344 input collapses to
+                # one downscaled tile and high-res detail is discarded
+                prefer_bigger = (
+                    rows * cols > best[0] * best[1]
+                    and H * W > 0.5 * rows * cols * ts * ts
+                )
+                if diff < best_diff or (diff == best_diff and prefer_bigger):
+                    best, best_diff = (rows, cols), diff
+        rows, cols = best
+        resized = normalize_image(resize_image(img, rows * ts, cols * ts),
+                                  (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+        tiles = [
+            resized[r * ts:(r + 1) * ts, c * ts:(c + 1) * ts]
+            for r in range(rows) for c in range(cols)
+        ]
+        if len(tiles) > 1:  # global view rides last (llama4 convention)
+            tiles.append(normalize_image(resize_image(img, ts, ts),
+                                         (0.5, 0.5, 0.5), (0.5, 0.5, 0.5)))
+        pixel = jnp.concatenate(
+            [patchify(t, self.patch_size)[0] for t in tiles], axis=0
+        )
+        g = ts // self.patch_size
+        return ProcessedImage(
+            pixel_values=pixel, grid=(len(tiles) * g, g),
+            num_placeholder_tokens=len(tiles) * g * g,
+        )
+
+
+class Phi4VisionProcessor(ImageProcessor):
+    """Phi-4-multimodal HD transform: 448-base crops under a dynamic_hd
+    budget plus a global view; token count follows the reference formula
+    ``256 + 1 + mask_sum + mask_col0_sum + 16`` (exact resize => full
+    masks: mask_sum = 256*crops, col0 = 16*h_crops).  Reference:
+    vision/processors/phi4_vision.rs."""
+
+    name = "phi4_v"
+
+    def __init__(self, base: int = 448, patch_size: int = 14,
+                 dynamic_hd: int = 36, merge_size: int = 2):
+        self.base = base
+        self.patch_size = patch_size
+        self.dynamic_hd = dynamic_hd
+        self.merge_size = merge_size
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        H, W = img.shape[:2]
+        ratio = W / H
+        cols = max(1, min(self.dynamic_hd,
+                          int(round(math.sqrt(self.dynamic_hd * ratio)))))
+        rows = max(1, min(self.dynamic_hd // cols, self.dynamic_hd))
+        b = self.base
+        main = normalize_image(resize_image(img, rows * b, cols * b),
+                               (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+        views = [normalize_image(resize_image(img, b, b),
+                                 (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))] + [
+            main[r * b:(r + 1) * b, c * b:(c + 1) * b]
+            for r in range(rows) for c in range(cols)
+        ]
+        pixel = jnp.concatenate(
+            [patchify(v, self.patch_size)[0] for v in views], axis=0
+        )
+        g = b // self.patch_size  # 32
+        per_view = (g // self.merge_size) ** 2  # 256
+        tokens = per_view + 1 + per_view * rows * cols + (g // 2) * rows + (g // 2)
+        return ProcessedImage(
+            pixel_values=pixel, grid=(len(views) * g, g),
+            num_placeholder_tokens=tokens,
+        )
+
+
+class KimiK25ImageProcessor(ImageProcessor):
+    """Kimi-K2.5: scale to fit the patch budget (never upscale), ZERO-PAD —
+    not resize — to (patch*merge)-multiples (the model trained on
+    zero-padded images), 2x2 merge (reference:
+    vision/processors/kimi_k25.rs)."""
+
+    name = "kimi_k25"
+
+    def __init__(self, patch_size: int = 14, merge_size: int = 2,
+                 in_patch_limit: int = 16384, side_patch_limit: int = 512):
+        self.patch_size = patch_size
+        self.merge_size = merge_size
+        self.in_patch_limit = in_patch_limit
+        self.side_patch_limit = side_patch_limit
+
+    def process(self, img: jnp.ndarray) -> ProcessedImage:
+        ps = self.patch_size
+        H, W = img.shape[:2]
+        side_cap = self.side_patch_limit * ps
+        area_cap = self.in_patch_limit * ps * ps
+        scale = min(1.0, side_cap / max(H, W),
+                    math.sqrt(area_cap / (H * W)))
+        h2, w2 = max(1, int(H * scale)), max(1, int(W * scale))
+        img = resize_image(img, h2, w2) if scale < 1.0 else img
+        img = normalize_image(img, (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+        factor = ps * self.merge_size
+        pad_h = (-img.shape[0]) % factor
+        pad_w = (-img.shape[1]) % factor
+        if pad_h or pad_w:
+            img = jnp.pad(img, ((0, pad_h), (0, pad_w), (0, 0)))
+        patches, grid = patchify(img, ps)
+        mgh, mgw = grid[0] // self.merge_size, grid[1] // self.merge_size
+        return ProcessedImage(
+            pixel_values=patches, grid=grid,
+            num_placeholder_tokens=mgh * mgw,
+            llm_grid=(mgh, mgw),
+        )
+
+
+class Qwen3OmniVisionProcessor(Qwen2VLImageProcessor):
+    """Qwen3-Omni vision leg: the Qwen smart-resize mechanism at patch 16
+    (reference: vision/processors/qwen3_omni_vision.rs constants)."""
+
+    name = "qwen3_omni"
+
+    def __init__(self, patch_size: int = 16, merge_size: int = 2,
+                 min_pixels: int = 3136, max_pixels: int = 12_845_056):
+        super().__init__(patch_size=patch_size, merge_size=merge_size,
+                         min_pixels=min_pixels, max_pixels=max_pixels)
+
+
 _PROCESSORS = {
     "qwen2_vl": Qwen2VLImageProcessor,
     "qwen3_vl": Qwen2VLImageProcessor,
@@ -238,11 +382,16 @@ _PROCESSORS = {
     "pixtral": PixtralImageProcessor,
     "gemma3": Gemma3ImageProcessor,
     "phi3_v": Phi3VisionImageProcessor,
+    "llama4": Llama4VisionProcessor,
+    "phi4_v": Phi4VisionProcessor,
+    "kimi_k25": KimiK25ImageProcessor,
+    "qwen3_omni": Qwen3OmniVisionProcessor,
 }
 
 _MODEL_MAP = [
     ("qwen2-vl", "qwen2_vl"),
     ("qwen2.5-vl", "qwen2_vl"),
+    ("qwen3-omni", "qwen3_omni"),
     ("qwen3-vl", "qwen3_vl"),
     ("llava", "llava"),
     ("internvl", "internvl"),
@@ -250,8 +399,15 @@ _MODEL_MAP = [
     ("mistral-small", "pixtral"),
     ("gemma-3", "gemma3"),
     ("gemma3", "gemma3"),
+    ("llama-4", "llama4"),
+    ("llama4", "llama4"),
+    ("phi-4", "phi4_v"),
+    ("phi4", "phi4_v"),
     ("phi-3", "phi3_v"),
     ("phi-3.5", "phi3_v"),
+    ("kimi-k2.5", "kimi_k25"),
+    ("kimi_k25", "kimi_k25"),
+    ("kimi-vl", "kimi_k25"),
 ]
 
 
@@ -292,4 +448,12 @@ def processor_for_worker(
         return Gemma3ImageProcessor(patch_size=ps or 14, merge_size=ms or 4)
     if family == "phi3_v":
         return Phi3VisionImageProcessor(patch_size=ps or 14, merge_size=ms or 2)
+    if family == "llama4":
+        return Llama4VisionProcessor(patch_size=ps or 14)
+    if family == "phi4_v":
+        return Phi4VisionProcessor(patch_size=ps or 14, merge_size=ms or 2)
+    if family == "kimi_k25":
+        return KimiK25ImageProcessor(patch_size=ps or 14, merge_size=ms or 2)
+    if family == "qwen3_omni":
+        return Qwen3OmniVisionProcessor(patch_size=ps or 16, merge_size=ms or 2)
     return Qwen2VLImageProcessor(patch_size=ps or 14, merge_size=ms or 2)

@@ -9,7 +9,12 @@ TPU-native decode structure (multi-step horizon, ``runner.decode_multi``):
   which XLA performs in place.  (Every design that updates the big cache
   *inside* the loop — functional scatters, layer-sliced scans, aliased
   kernel writes — measured 17-90 ms/step of pure cache copying at 1B
-  serving sizes; single-row in-kernel DMA writes violate sublane tiling.)
+  serving sizes; single-row in-kernel DMA writes violate sublane tiling.
+  PROVENANCE: one-off interactive v5e-1 measurements during round-3
+  development, not recorded in a committed BENCH artifact — the
+  environment's TPU has been unreachable every round.  The DESIGN
+  conclusion (don't copy the cache per step) holds regardless of the
+  exact constants.)
 
 - Attention therefore covers two ranges: cache pages (tokens < entry
   position, streamed HBM→VMEM with double-buffered DMA) and the first

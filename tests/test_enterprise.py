@@ -424,7 +424,8 @@ def test_jwks_rs256_verify_and_claims():
 
     # tampered payload -> bad signature
     h, b, s = token.split(".")
-    forged = f"{h}.{_b64u(b'{\"sub\": \"mallory\"}')}.{s}"
+    forged_body = _b64u(b'{"sub": "mallory"}')
+    forged = f"{h}.{forged_body}.{s}"
     with pytest.raises(AuthError, match="bad signature|malformed"):
         v.verify(forged)
 

@@ -217,3 +217,129 @@ def test_video_gif_decode():
     decoded = decode_video_bytes(buf.getvalue())
     assert len(decoded) == 5
     assert decoded[0].shape == (16, 16, 3)
+
+
+# ---- r5 families: llama4 / phi4 / kimi-k2.5 / qwen3-omni (VERDICT #8) ----
+
+
+def test_r5_processor_registry():
+    from smg_tpu.multimodal.processor import (
+        KimiK25ImageProcessor,
+        Llama4VisionProcessor,
+        Phi4VisionProcessor,
+        Qwen3OmniVisionProcessor,
+    )
+
+    assert isinstance(get_image_processor("meta-llama/Llama-4-Scout"),
+                      Llama4VisionProcessor)
+    assert isinstance(get_image_processor("microsoft/Phi-4-multimodal"),
+                      Phi4VisionProcessor)
+    assert isinstance(get_image_processor("moonshotai/Kimi-K2.5"),
+                      KimiK25ImageProcessor)
+    assert isinstance(get_image_processor("Qwen/Qwen3-Omni-30B"),
+                      Qwen3OmniVisionProcessor)
+    # phi-3 still routes to the phi3 HD transform, not phi4
+    from smg_tpu.multimodal.processor import Phi3VisionImageProcessor
+
+    assert isinstance(get_image_processor("microsoft/Phi-3.5-vision"),
+                      Phi3VisionImageProcessor)
+
+
+def test_llama4_tiling_tokens():
+    from smg_tpu.multimodal.processor import Llama4VisionProcessor
+
+    p = Llama4VisionProcessor()
+    out = p.process(_img(336, 336))
+    # single tile: no global view, 24x24 patches
+    assert out.num_placeholder_tokens == 576
+    out2 = p.process(_img(336, 672))  # 1x2 tiles + global
+    assert out2.num_placeholder_tokens == 3 * 576
+    g = 336 // 14
+    assert out2.pixel_values.shape[0] == 3 * g * g
+
+
+def test_phi4_token_formula():
+    from smg_tpu.multimodal.processor import Phi4VisionProcessor
+
+    p = Phi4VisionProcessor(dynamic_hd=4)
+    out = p.process(_img(448, 896))  # 2:1 aspect -> 1x3 crops (sqrt rule)
+    rows, cols = 1, 3
+    expect = 256 + 1 + 256 * rows * cols + 16 * rows + 16
+    assert out.num_placeholder_tokens == expect
+    sq = p.process(_img(448, 448))  # square -> 2x2 crops
+    assert sq.num_placeholder_tokens == 256 + 1 + 256 * 4 + 16 * 2 + 16
+
+
+def test_kimi_zero_pads_not_resizes():
+    from smg_tpu.multimodal.processor import KimiK25ImageProcessor
+
+    p = KimiK25ImageProcessor()
+    out = p.process(_img(30, 45))  # not factor-aligned; must ZERO-PAD to 56
+    gh, gw = out.grid
+    assert gh * 14 % (14 * 2) == 0 and gw * 14 % (14 * 2) == 0
+    assert out.llm_grid == (gh // 2, gw // 2)
+    assert out.num_placeholder_tokens == (gh // 2) * (gw // 2)
+    # padding regions are zeros: the last row of patches for a 30-high image
+    # padded to 56 contains all-zero pixels
+    pv = np.asarray(out.pixel_values)
+    assert np.isclose(pv, 0).any()
+    # no upscale: a huge image is scaled DOWN under the side/area caps
+    big = p.process(_img(14 * 600, 14 * 20))
+    assert big.grid[0] <= p.side_patch_limit
+
+
+def test_qwen3_omni_patch16():
+    from smg_tpu.multimodal.processor import Qwen3OmniVisionProcessor
+
+    p = Qwen3OmniVisionProcessor()
+    out = p.process(_img(128, 128))
+    assert out.llm_grid is not None  # planar grid (M-RoPE capable)
+    # patch 16: 128 -> grid multiples of merge over 16px patches
+    assert out.pixel_values.shape[1] == 16 * 16 * 3
+
+
+# ---- pixel cache (VERDICT r4 missing #8: pixel_cache.rs analog) ----
+
+
+def test_pixel_cache_lru_and_keys():
+    from smg_tpu.multimodal.pixel_cache import (
+        PixelCache,
+        image_source_hash,
+        processor_fingerprint,
+    )
+    from smg_tpu.multimodal.processor import Qwen2VLImageProcessor
+
+    part_a = {"type": "image_url", "image_url": {"url": "data:image/png;base64,AAAA"}}
+    part_b = {"type": "image_url", "image_url": {"url": "data:image/png;base64,BBBB"}}
+    assert image_source_hash(part_a) == image_source_hash(dict(part_a))
+    assert image_source_hash(part_a) != image_source_hash(part_b)
+    fp1 = processor_fingerprint(Qwen2VLImageProcessor(patch_size=14))
+    fp2 = processor_fingerprint(Qwen2VLImageProcessor(patch_size=16))
+    assert fp1 != fp2  # same bytes, different geometry -> different entry
+
+    cache = PixelCache(max_bytes=3000)
+    e1 = (np.zeros((4, 256), np.float32), (2, 2), 4, None)  # ~4KB > cap: skipped
+    cache.put(("k1", fp1), e1)
+    assert cache.get(("k1", fp1)) is None
+    small = (np.zeros((1, 128), np.float32), (1, 1), 1, None)
+    cache.put(("k1", fp1), small)
+    assert cache.get(("k1", fp1)) is not None
+    assert cache.stats()["hits"] == 1
+    # LRU eviction under the byte cap
+    for i in range(10):
+        cache.put((f"k{i}", fp1), small)
+    assert cache.size_bytes <= 3000
+    assert cache.get(("k1", fp1)) is None  # evicted as oldest
+
+
+def test_pixel_cache_env_gate(monkeypatch):
+    import smg_tpu.multimodal.pixel_cache as pc
+
+    monkeypatch.setattr(pc, "_global", None)
+    monkeypatch.delenv("SMG_MM_PIXEL_CACHE_MB", raising=False)
+    assert pc.get_pixel_cache() is None  # disabled by default
+    monkeypatch.setenv("SMG_MM_PIXEL_CACHE_MB", "8")
+    monkeypatch.setattr(pc, "_global", None)
+    c = pc.get_pixel_cache()
+    assert c is not None and c.max_bytes == 8 * 2**20
+    monkeypatch.setattr(pc, "_global", None)
