@@ -82,7 +82,123 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
                    help="reject requests when a plugin hook faults "
                         "(default: fail-open, log and continue)")
     g.add_argument("--log-level", default="INFO")
+    g.add_argument("--log-json", action="store_true",
+                   help="structured JSON log lines (reference: --log-json)")
     g.add_argument("--prometheus-port", type=int, default=None)
+    g.add_argument("--prometheus-host", default="0.0.0.0")
+    g.add_argument("--health-check-port", type=int, default=None,
+                   dest="health_check_port",
+                   help="dedicated probe listener (liveness/readiness/health "
+                        "served on their own port so a saturated gateway "
+                        "cannot starve k8s probes)")
+    g.add_argument("--tls-cert-path", default=None, dest="tls_cert_path",
+                   help="serve HTTPS with this certificate")
+    g.add_argument("--tls-key-path", default=None, dest="tls_key_path")
+    g.add_argument("--max-payload-size", type=int, default=256 * 2**20,
+                   dest="max_payload_size",
+                   help="request body cap in bytes (reference default 256MB)")
+    g.add_argument("--request-timeout-secs", type=float, default=1800.0,
+                   dest="request_timeout_secs")
+    g.add_argument("--cors-allowed-origins", action="append", default=[],
+                   dest="cors_allowed_origins",
+                   help="origin allowed for CORS (repeatable; unset = off)")
+    g.add_argument("--request-id-headers", action="append", default=[],
+                   dest="request_id_headers",
+                   help="extra header names accepted as the request id")
+    g.add_argument("--harmony", default=None, choices=["on", "off", "auto"],
+                   help="harmony (gpt-oss) pipeline: auto-detect by model "
+                        "name (default), or force on/off")
+    g.add_argument("--reasoning-parser", default=None, dest="reasoning_parser",
+                   help="force a reasoning parser family (default: by model)")
+    g.add_argument("--tool-call-parser", default=None, dest="tool_call_parser",
+                   help="force a tool-call parser dialect (default: by model)")
+    g.add_argument("--mcp-config-path", default=None, dest="mcp_config_path",
+                   help="JSON file of MCP servers: "
+                        '[{"name": ..., "url": ..., "headers": {...}}]')
+
+    pol = p.add_argument_group("Routing policy")
+    pol.add_argument("--cache-threshold", type=float, default=0.5,
+                     help="cache-aware: min prefix-match ratio for affinity")
+    pol.add_argument("--balance-abs-threshold", type=int, default=32,
+                     help="cache-aware: absolute load-imbalance trigger")
+    pol.add_argument("--balance-rel-threshold", type=float, default=1.5,
+                     help="cache-aware: relative load-imbalance trigger")
+    pol.add_argument("--max-tree-size", type=int, default=2**20,
+                     help="cache-aware: approximation tree node budget")
+    pol.add_argument("--block-size", type=int, default=16,
+                     help="KV block size for event-driven cache-aware routing")
+    pol.add_argument("--prefix-token-count", type=int, default=256,
+                     help="prefix_hash: tokens hashed for placement")
+    pol.add_argument("--dp-aware", action=argparse.BooleanOptionalAction,
+                     default=True,
+                     help="pin requests to DP engine replicas by min-token "
+                          "load (default on; --no-dp-aware lets the worker "
+                          "balance locally)")
+    pol.add_argument("--enable-igw", action="store_true",
+                     help="compat flag: multi-model (IGW) routing is always "
+                          "on in this gateway — accepted for reference CLI "
+                          "parity")
+
+    rl = p.add_argument_group("Reliability")
+    rl.add_argument("--retry-max-retries", type=int, default=3)
+    rl.add_argument("--retry-initial-backoff-ms", type=int, default=100)
+    rl.add_argument("--retry-max-backoff-ms", type=int, default=2000)
+    rl.add_argument("--disable-retries", action="store_true")
+    rl.add_argument("--cb-failure-threshold", type=int, default=5,
+                    help="consecutive failures before the circuit opens")
+    rl.add_argument("--cb-success-threshold", type=int, default=2,
+                    help="half-open successes before the circuit closes")
+    rl.add_argument("--cb-timeout-duration-secs", type=float, default=30.0,
+                    help="open-state cooldown before half-open probes")
+    rl.add_argument("--disable-circuit-breaker", action="store_true")
+    rl.add_argument("--health-check-interval-secs", type=float, default=10.0)
+    rl.add_argument("--health-check-timeout-secs", type=float, default=5.0)
+    rl.add_argument("--health-failure-threshold", type=int, default=3)
+    rl.add_argument("--health-success-threshold", type=int, default=2)
+    rl.add_argument("--disable-health-check", action="store_true")
+    rl.add_argument("--worker-startup-timeout-secs", type=float, default=75.0,
+                    help="budget for startup worker registration workflows")
+
+    sched = p.add_argument_group("Scheduling / limits")
+    sched.add_argument("--priority-scheduler-enabled", action="store_true")
+    sched.add_argument("--priority-slots", type=int, default=256,
+                       help="execution slots the priority scheduler manages")
+    sched.add_argument("--rate-limit-tokens-per-second", type=float, default=0.0,
+                       help="per-tenant sustained request rate (0 = off)")
+    sched.add_argument("--rate-limit-burst", type=float, default=256.0,
+                       help="per-tenant burst capacity")
+
+    auth = p.add_argument_group("Auth")
+    auth.add_argument("--api-key", action="append", default=[], dest="api_keys",
+                      help="accepted API key, optionally KEY:TENANT[:ROLE] "
+                           "(repeatable; any key enables auth)")
+    auth.add_argument("--jwt-secret", default=None, dest="jwt_secret",
+                      help="HS256 bearer verification secret")
+    auth.add_argument("--jwt-jwks-uri", default=None, dest="jwt_jwks_uri",
+                      help="JWKS endpoint for RS256/OIDC bearer verification")
+    auth.add_argument("--jwt-issuer", default=None, dest="jwt_issuer")
+    auth.add_argument("--jwt-audience", default=None, dest="jwt_audience")
+    auth.add_argument("--trust-tenant-header", action="store_true",
+                      help="accept X-Tenant-Id (or --tenant-header-name) "
+                           "from clients without auth")
+    auth.add_argument("--tenant-header-name", default="X-Tenant-Id",
+                      dest="tenant_header_name")
+
+    disc = p.add_argument_group("Service discovery")
+    disc.add_argument("--service-discovery", action="store_true",
+                      help="watch Kubernetes pods and (de)register workers")
+    disc.add_argument("--service-discovery-namespace", default=None,
+                      dest="service_discovery_namespace")
+    disc.add_argument("--selector", action="append", default=[],
+                      dest="selectors",
+                      help="pod label selector key=value (repeatable)")
+    disc.add_argument("--prefill-selector", action="append", default=[],
+                      dest="prefill_selectors")
+    disc.add_argument("--decode-selector", action="append", default=[],
+                      dest="decode_selectors")
+    disc.add_argument("--service-discovery-port", type=int, default=30001,
+                      dest="service_discovery_port",
+                      help="worker port discovered pods serve on")
 
 
 def _add_engine_args(p: argparse.ArgumentParser) -> None:
@@ -105,9 +221,16 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                    choices=["bfloat16", "float32", "float16"],
                    help="KV cache dtype (default: follow --dtype)")
     g.add_argument("--speculative", action="store_true",
-                   help="prompt-lookup speculative decoding for greedy "
-                        "requests (token-identical output)")
+                   help="speculative decoding: n-gram prompt-lookup drafts "
+                        "(or a draft model via --draft-model-path); greedy "
+                        "output stays token-identical, sampling uses "
+                        "distribution-preserving rejection sampling")
     g.add_argument("--spec-max-draft", type=int, default=8, dest="spec_max_draft")
+    g.add_argument("--draft-model-path", default=None, dest="draft_model_path",
+                   help="HF-format dir of a small draft model (replaces "
+                        "n-gram proposals)")
+    g.add_argument("--draft-model-preset", default=None, dest="draft_model_preset",
+                   help="named preset for the draft model")
     g.add_argument("--max-batch-size", type=int, default=64)
     g.add_argument("--max-seq-len", type=int, default=8192)
     g.add_argument("--page-size", type=int, default=16)
@@ -117,26 +240,15 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     from smg_tpu.utils.logging import configure
 
-    configure(level=getattr(args, "log_level", "INFO"))
+    configure(level=getattr(args, "log_level", "INFO"),
+              json_logs=getattr(args, "log_json", False) or None)
     # validate before any port binds or chip touches (reference:
     # ConfigValidator::validate at startup, config/validation.rs)
     if args.command in ("launch", "serve"):
-        from smg_tpu.config import validate_gateway_config
-        from smg_tpu.config.validation import raise_on_errors
+        from smg_tpu.config.validation import raise_on_errors, validate_cli_args
         from smg_tpu.utils import get_logger
 
-        raise_on_errors(
-            validate_gateway_config(
-                policy=args.policy,
-                workers=args.workers,
-                prefill_workers=args.prefill_workers,
-                decode_workers=args.decode_workers,
-                max_concurrent_requests=args.max_concurrent_requests,
-                kv_connector=args.kv_connector,
-                mesh_port=args.mesh_port,
-            ),
-            logger=get_logger("config"),
-        )
+        raise_on_errors(validate_cli_args(args), logger=get_logger("config"))
     if args.command in ("launch", "serve", "worker"):
         from smg_tpu.gateway.launch import run_command
 

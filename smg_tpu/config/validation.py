@@ -207,6 +207,151 @@ def validate_gateway_config(
     return issues
 
 
+def validate_cli_args(args) -> list[ValidationIssue]:
+    """Cross-field validation over the full launch/serve flag namespace
+    (reference: ``config/validation.rs`` ConfigValidator — ~140 flags pass
+    a coherence check before anything binds a port or touches a chip)."""
+    g = lambda name, default=None: getattr(args, name, default)  # noqa: E731
+    issues = validate_gateway_config(
+        policy=g("policy"),
+        workers=g("workers", []),
+        prefill_workers=g("prefill_workers", []),
+        decode_workers=g("decode_workers", []),
+        max_concurrent_requests=g("max_concurrent_requests"),
+        kv_connector=g("kv_connector"),
+        mesh_port=g("mesh_port"),
+    )
+
+    # ---- server / TLS
+    if bool(g("tls_cert_path")) != bool(g("tls_key_path")):
+        issues.append(_err(
+            "tls_cert_path/tls_key_path",
+            "TLS needs BOTH the certificate and the key",
+        ))
+    if g("health_check_port") is not None and g("health_check_port") == g("port"):
+        issues.append(_err(
+            "health_check_port",
+            "the dedicated probe port must differ from the main port",
+        ))
+    if g("max_payload_size") is not None and g("max_payload_size") < 1024:
+        issues.append(_err("max_payload_size", "must be >= 1KiB"))
+    if g("request_timeout_secs") is not None and g("request_timeout_secs") <= 0:
+        issues.append(_err("request_timeout_secs", "must be positive"))
+
+    # ---- retries / circuit breaker / health
+    if g("retry_initial_backoff_ms") is not None and g("retry_max_backoff_ms") is not None:
+        if g("retry_initial_backoff_ms") > g("retry_max_backoff_ms"):
+            issues.append(_err(
+                "retry_initial_backoff_ms",
+                f"initial backoff {g('retry_initial_backoff_ms')}ms exceeds "
+                f"max {g('retry_max_backoff_ms')}ms",
+            ))
+    if g("retry_max_retries") is not None and g("retry_max_retries") < 0:
+        issues.append(_err("retry_max_retries", "must be >= 0"))
+    for fld in ("cb_failure_threshold", "cb_success_threshold",
+                "health_failure_threshold", "health_success_threshold"):
+        if g(fld) is not None and g(fld) < 1:
+            issues.append(_err(fld, "must be >= 1"))
+    if (g("health_check_timeout_secs") is not None
+            and g("health_check_interval_secs") is not None
+            and g("health_check_timeout_secs") >= g("health_check_interval_secs")):
+        issues.append(_warn(
+            "health_check_timeout_secs",
+            "probe timeout >= probe interval: checks can pile up",
+        ))
+    if g("disable_retries") and g("disable_circuit_breaker"):
+        issues.append(_warn(
+            "disable_retries/disable_circuit_breaker",
+            "no retries AND no breaker: every transient worker hiccup "
+            "surfaces to clients immediately",
+        ))
+
+    # ---- policy knobs
+    if g("cache_threshold") is not None and not (0.0 <= g("cache_threshold") <= 1.0):
+        issues.append(_err("cache_threshold", "must be in [0, 1]"))
+    if g("balance_rel_threshold") is not None and g("balance_rel_threshold") < 1.0:
+        issues.append(_err(
+            "balance_rel_threshold", "relative imbalance factor must be >= 1"
+        ))
+    if g("block_size") is not None and (
+        g("block_size") < 1 or g("block_size") & (g("block_size") - 1)
+    ):
+        issues.append(_warn(
+            "block_size", "not a power of two: radix pages won't tile KV pages"
+        ))
+    pol = g("policy")
+    if pol not in (None, "cache_aware") and g("cache_threshold") not in (None, 0.5):
+        issues.append(_warn(
+            "cache_threshold", f"ignored by policy {pol!r} (cache_aware only)"
+        ))
+
+    # ---- scheduling / limits
+    if g("priority_slots") is not None and g("priority_slots") < 1:
+        issues.append(_err("priority_slots", "must be >= 1"))
+    rl_rate = g("rate_limit_tokens_per_second")
+    if rl_rate is not None and rl_rate < 0:
+        issues.append(_err("rate_limit_tokens_per_second", "must be >= 0"))
+    if (rl_rate or 0) > 0 and (g("rate_limit_burst") or 0) < rl_rate:
+        issues.append(_warn(
+            "rate_limit_burst",
+            "burst below the sustained rate throttles steady traffic",
+        ))
+
+    # ---- auth
+    for spec in g("api_keys", []) or []:
+        if not spec or spec.startswith(":"):
+            issues.append(_err("api_key", f"malformed key spec {spec!r}"))
+    if (g("jwt_issuer") or g("jwt_audience")) and not g("jwt_jwks_uri"):
+        issues.append(_warn(
+            "jwt_issuer/jwt_audience",
+            "issuer/audience claims are only checked on the JWKS (RS256) "
+            "path; set --jwt-jwks-uri",
+        ))
+    if g("trust_tenant_header") and not (
+        g("api_keys") or g("jwt_secret") or g("jwt_jwks_uri")
+    ):
+        issues.append(_warn(
+            "trust_tenant_header",
+            "without auth the tenant header is already trusted; flag is "
+            "redundant",
+        ))
+
+    # ---- harmony / parsers
+    if g("harmony") == "on" and (g("reasoning_parser") or g("tool_call_parser")):
+        issues.append(_warn(
+            "harmony",
+            "the harmony pipeline performs its own channel demux; "
+            "--reasoning-parser/--tool-call-parser are ignored for it",
+        ))
+
+    # ---- service discovery
+    if not g("service_discovery") and (
+        g("selectors") or g("prefill_selectors") or g("decode_selectors")
+    ):
+        issues.append(_warn(
+            "selector", "selectors given but --service-discovery is off"
+        ))
+
+    # ---- speculative draft (serve mode)
+    if (g("draft_model_path") or g("draft_model_preset")) and not g("speculative"):
+        issues.append(_err(
+            "draft_model_path",
+            "a draft model needs --speculative to take effect",
+        ))
+    if g("spec_max_draft") is not None and g("spec_max_draft") < 1:
+        issues.append(_err("spec_max_draft", "must be >= 1"))
+
+    # ---- mesh TLS coherence
+    tls_parts = [g("mesh_tls_cert"), g("mesh_tls_key"), g("mesh_tls_ca")]
+    if any(tls_parts) and not all(tls_parts):
+        issues.append(_err(
+            "mesh_tls_cert/mesh_tls_key/mesh_tls_ca",
+            "mesh mTLS needs cert + key + CA together (partial TLS would "
+            "silently downgrade gossip to plaintext)",
+        ))
+    return issues
+
+
 def raise_on_errors(issues: list[ValidationIssue], logger=None) -> None:
     """Log warnings; raise ConfigError if any error-severity issues exist."""
     errors = [i for i in issues if i.severity == "error"]

@@ -31,6 +31,9 @@ class DiscoveryConfig:
     selector: str = "app=smg-worker"
     poll_interval_secs: float = 10.0
     default_port: int = 30001
+    # role for pods WITHOUT a smg.ai/role label (per-role selector groups:
+    # --prefill-selector pods default to prefill without labelling)
+    default_role: str = "regular"
 
 
 class KubeApi:
@@ -148,7 +151,7 @@ class ServiceDiscovery:
                 continue
             labels = meta.get("labels", {})
             annotations = meta.get("annotations", {})
-            role = labels.get(ROLE_LABEL, "regular")
+            role = labels.get(ROLE_LABEL, self.config.default_role)
             wtype = {
                 "prefill": WorkerType.PREFILL,
                 "decode": WorkerType.DECODE,

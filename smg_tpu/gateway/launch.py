@@ -49,6 +49,12 @@ def build_engine_from_args(args):
     else:
         raise SystemExit("need --model-path or --model-preset")
 
+    draft_model = None
+    if getattr(args, "draft_model_path", None):
+        draft_model = ModelConfig.from_pretrained(args.draft_model_path)
+    elif getattr(args, "draft_model_preset", None):
+        draft_model = PRESETS[args.draft_model_preset]()
+
     cfg = EngineConfig(
         model=model,
         model_path=args.model_path,
@@ -71,6 +77,7 @@ def build_engine_from_args(args):
         ),
         model_id=args.model_path or args.model_preset,
         dtype=getattr(args, "dtype", "bfloat16"),
+        draft_model=draft_model,
     )
     params = None
     vision_params = None
@@ -128,16 +135,137 @@ async def _run_gateway(args) -> int:
 
     from smg_tpu.gateway.router import RouterConfig
 
+    # ---- flag groups -> sub-configs (reference: main.rs:157-816 flag
+    # groups through RouterConfig construction) ----
+    harmony_flag = {None: None, "auto": None, "on": True, "off": False}[
+        getattr(args, "harmony", None)
+    ]
+    router_config = RouterConfig(
+        kv_connector=getattr(args, "kv_connector", "auto"),
+        max_retries=(0 if getattr(args, "disable_retries", False)
+                     else getattr(args, "retry_max_retries", 3)),
+        retry_backoff_base=getattr(args, "retry_initial_backoff_ms", 100) / 1e3,
+        retry_backoff_max=getattr(args, "retry_max_backoff_ms", 2000) / 1e3,
+        reasoning_parser=getattr(args, "reasoning_parser", None),
+        tool_parser=getattr(args, "tool_call_parser", None),
+        harmony=harmony_flag,
+        # min-token replica pinning is the long-standing default;
+        # --no-dp-aware opts into worker-local balancing
+        dp_rank_policy=("dp_min_token" if getattr(args, "dp_aware", True)
+                       else "dp_passthrough"),
+    )
+    policy_kwargs = {}
+    if args.policy == "cache_aware":
+        policy_kwargs = {
+            "match_threshold": getattr(args, "cache_threshold", 0.5),
+            "imbalance_abs": getattr(args, "balance_abs_threshold", 32),
+            "imbalance_rel": getattr(args, "balance_rel_threshold", 1.5),
+            "max_tree_size": getattr(args, "max_tree_size", 2**20),
+            "page_size": getattr(args, "block_size", 16),
+        }
+    elif args.policy == "prefix_hash":
+        policy_kwargs = {
+            "prefix_tokens": getattr(args, "prefix_token_count", 256),
+        }
+    auth_config = None
+    api_keys = getattr(args, "api_keys", [])
+    if api_keys or getattr(args, "jwt_secret", None) or getattr(args, "jwt_jwks_uri", None):
+        from smg_tpu.gateway.auth import AuthConfig, JwksVerifier, Principal
+
+        keys = {}
+        for spec in api_keys:
+            key, _, rest = spec.partition(":")
+            tenant, _, role = rest.partition(":")
+            keys[key] = Principal(
+                id=f"key-{key[:6]}", tenant=tenant or "default",
+                roles=(role,) if role else ("user",),
+            )
+        jwks = None
+        if getattr(args, "jwt_jwks_uri", None):
+            uri = args.jwt_jwks_uri
+
+            def _fetch_jwks(uri=uri):
+                import json as _json
+                import urllib.request
+
+                with urllib.request.urlopen(uri, timeout=10) as r:
+                    return _json.loads(r.read())
+
+            jwks = JwksVerifier(
+                _fetch_jwks,
+                issuer=getattr(args, "jwt_issuer", None),
+                audience=getattr(args, "jwt_audience", None),
+            )
+        auth_config = AuthConfig(
+            enabled=True, api_keys=keys,
+            jwt_secret=getattr(args, "jwt_secret", None), jwks=jwks,
+        )
+    rate_limit_config = None
+    if getattr(args, "rate_limit_tokens_per_second", 0.0):
+        from smg_tpu.gateway.rate_limit import RateLimitConfig
+
+        rate_limit_config = RateLimitConfig(
+            capacity=getattr(args, "rate_limit_burst", 256.0),
+            refill_per_sec=args.rate_limit_tokens_per_second,
+            max_concurrent=args.max_concurrent_requests,
+        )
+    priority_config = None
+    if getattr(args, "priority_scheduler_enabled", False):
+        from smg_tpu.gateway.priority import PriorityConfig
+
+        priority_config = PriorityConfig(slots=getattr(args, "priority_slots", 256))
+    from smg_tpu.gateway.health import HealthConfig
+
+    # disable = an interval no deployment outlives (the monitor machinery
+    # stays constructed so /health handlers keep working)
+    health_config = HealthConfig(
+        interval_secs=(1e9 if getattr(args, "disable_health_check", False)
+                       else getattr(args, "health_check_interval_secs", 10.0)),
+        timeout_secs=getattr(args, "health_check_timeout_secs", 5.0),
+        failure_threshold=getattr(args, "health_failure_threshold", 3),
+        success_threshold=getattr(args, "health_success_threshold", 2),
+    )
+    # circuit-breaker defaults apply to every subsequently created Worker
+    from smg_tpu.gateway.workers import CircuitBreaker
+
+    CircuitBreaker.DEFAULT_FAILURE_THRESHOLD = (
+        10**9 if getattr(args, "disable_circuit_breaker", False)
+        else getattr(args, "cb_failure_threshold", 5)
+    )
+    CircuitBreaker.DEFAULT_SUCCESS_THRESHOLD = getattr(args, "cb_success_threshold", 2)
+    CircuitBreaker.DEFAULT_COOLDOWN_SECS = getattr(args, "cb_timeout_duration_secs", 30.0)
     ctx = AppContext(
         policy=args.policy,
-        router_config=RouterConfig(
-            kv_connector=getattr(args, "kv_connector", "auto")
-        ),
+        router_config=router_config,
         max_concurrent_requests=args.max_concurrent_requests,
+        policy_kwargs=policy_kwargs,
+        auth_config=auth_config,
+        rate_limit_config=rate_limit_config,
+        priority_config=priority_config,
+        health_config=health_config,
         storage=getattr(args, "storage", None),
         otel_endpoint=getattr(args, "otel_endpoint", None),
         otel_service_name=getattr(args, "otel_service_name", "smg-tpu"),
+        request_id_headers=list(getattr(args, "request_id_headers", []) or []),
+        tenant_header=getattr(args, "tenant_header_name", "X-Tenant-Id"),
+        # without auth the tenant header is all there is; with auth it must
+        # be explicitly trusted
+        trust_tenant_header=(getattr(args, "trust_tenant_header", False)
+                             or auth_config is None),
+        request_timeout_secs=getattr(args, "request_timeout_secs", None),
+        cors_allowed_origins=list(getattr(args, "cors_allowed_origins", []) or []),
     )
+    if getattr(args, "mcp_config_path", None):
+        import json as _json
+
+        from smg_tpu.mcp import HttpMcpServer
+
+        with open(args.mcp_config_path) as f:
+            for spec in _json.load(f):
+                ctx.mcp.add(HttpMcpServer(
+                    name=spec.get("name", spec["url"]), url=spec["url"],
+                    headers=spec.get("headers"),
+                ))
     if getattr(args, "provider_config", None):
         ctx.providers.load_config(args.provider_config)
     if getattr(args, "mm_transport", None):
@@ -207,9 +335,35 @@ async def _run_gateway(args) -> int:
         # the wait must outlast the workflow's model_info retry budget
         # (~36s of backoff for a cold-booting worker) or a late success
         # races the mock-fallback default below
+        budget = getattr(args, "worker_startup_timeout_secs", 75.0)
         await asyncio.gather(
-            *(_register_worker(url, wtype, 75.0) for url, wtype in role_urls)
+            *(_register_worker(url, wtype, budget) for url, wtype in role_urls)
         )
+
+    discoveries = []
+    if getattr(args, "service_discovery", False):
+        from smg_tpu.gateway.discovery import DiscoveryConfig, ServiceDiscovery
+
+        ns = getattr(args, "service_discovery_namespace", None) or "default"
+        port = getattr(args, "service_discovery_port", 30001)
+        # one watcher per role selector group: pods matched by a role
+        # selector default to that role even without a smg.ai/role label
+        groups = [(",".join(getattr(args, "selectors", [])) or "app=smg-worker",
+                   "regular")]
+        if getattr(args, "prefill_selectors", []):
+            groups.append((",".join(args.prefill_selectors), "prefill"))
+        if getattr(args, "decode_selectors", []):
+            groups.append((",".join(args.decode_selectors), "decode"))
+        for selector, role in groups:
+            d = ServiceDiscovery(
+                ctx.registry,
+                DiscoveryConfig(namespace=ns, selector=selector,
+                                default_port=port, default_role=role),
+            )
+            d.start()
+            discoveries.append(d)
+            logger.info("k8s service discovery on (selector %s, role %s)",
+                        selector, role)
 
     if args.command == "launch" and ctx.tokenizers.get(None) is None:
         # nothing explicit and no worker handed one over: mock fallback.
@@ -236,19 +390,56 @@ async def _run_gateway(args) -> int:
         TreeSyncAdapter(ctx.policies, mesh_node.state)
         logger.info("HA mesh enabled on port %d", args.mesh_port)
 
-    app = build_app(ctx)
+    app = build_app(ctx, client_max_size=getattr(args, "max_payload_size",
+                                                 256 * 2**20))
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, args.host, args.port)
+    ssl_ctx = None
+    if getattr(args, "tls_cert_path", None):
+        import ssl
+
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(args.tls_cert_path, args.tls_key_path)
+    site = web.TCPSite(runner, args.host, args.port, ssl_context=ssl_ctx)
     await site.start()
-    logger.info("gateway listening on %s:%d", args.host, args.port)
+    logger.info("gateway listening on %s:%d%s", args.host, args.port,
+                " (TLS)" if ssl_ctx else "")
+    probe_site = None
+    if getattr(args, "health_check_port", None):
+        # dedicated probe listener: /health /liveness /readiness stay
+        # reachable even when the main port saturates (reference:
+        # --health-check-port's isolated probe runtime)
+        probe_site = web.TCPSite(runner, args.host, args.health_check_port)
+        await probe_site.start()
+        logger.info("probe listener on %s:%d", args.host, args.health_check_port)
+    metrics_runner = None
+    if getattr(args, "prometheus_port", None):
+        # metrics-only listener (scrapers shouldn't reach inference routes)
+        from smg_tpu.gateway.server import h_metrics
+
+        mapp = web.Application()
+        mapp["ctx"] = ctx
+        mapp.router.add_get("/metrics", h_metrics)
+        metrics_runner = web.AppRunner(mapp)
+        await metrics_runner.setup()
+        await web.TCPSite(
+            metrics_runner, getattr(args, "prometheus_host", "0.0.0.0"),
+            args.prometheus_port,
+        ).start()
+        logger.info("prometheus exporter on %s:%d",
+                    getattr(args, "prometheus_host", "0.0.0.0"),
+                    args.prometheus_port)
     try:
         while True:
             await asyncio.sleep(3600)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        for d in discoveries:
+            await d.aclose()
         if mesh_node is not None:
             await mesh_node.stop()
+        if metrics_runner is not None:
+            await metrics_runner.cleanup()
         await runner.cleanup()
     return 0

@@ -44,6 +44,7 @@ class AppContext:
         policy: str = "cache_aware",
         router_config: RouterConfig | None = None,
         max_concurrent_requests: int = 256,
+        policy_kwargs: dict | None = None,
         auth_config=None,
         rate_limit_config=None,
         priority_config=None,
@@ -51,6 +52,11 @@ class AppContext:
         storage: str | None = None,
         otel_endpoint: str | None = None,
         otel_service_name: str = "smg-tpu",
+        request_id_headers: list | None = None,
+        tenant_header: str = "X-Tenant-Id",
+        trust_tenant_header: bool | None = None,
+        request_timeout_secs: float | None = None,
+        cors_allowed_origins: list | None = None,
     ):
         from smg_tpu.gateway.auth import AuthConfig, Authenticator
         from smg_tpu.gateway.health import HealthMonitor
@@ -61,7 +67,7 @@ class AppContext:
         from smg_tpu.gateway.providers import ProviderRegistry
 
         self.registry = WorkerRegistry()
-        self.policies = PolicyRegistry(default=policy)
+        self.policies = PolicyRegistry(default=policy, **(policy_kwargs or {}))
         self.providers = ProviderRegistry()
         self.tokenizers = TokenizerRegistry()
         self.kv_monitor = KvEventMonitor(self.registry, self.policies)
@@ -77,6 +83,17 @@ class AppContext:
         self.semaphore = asyncio.Semaphore(max_concurrent_requests)
         self.metrics = Metrics()
         self.auth = Authenticator(auth_config or AuthConfig())
+        # request identity / tenancy / limits plumbing (CLI flag groups)
+        self.request_id_headers = list(request_id_headers or [])
+        self.tenant_header = tenant_header
+        # None = trust exactly when no auth is configured
+        self.trust_tenant_header = (
+            trust_tenant_header
+            if trust_tenant_header is not None
+            else not self.auth.config.enabled
+        )
+        self.request_timeout_secs = request_timeout_secs
+        self.cors_allowed_origins = list(cors_allowed_origins or [])
         self.rate_limiter = RateLimiter(
             rate_limit_config
             or RateLimitConfig(
@@ -188,7 +205,15 @@ def _sse_response(request: web.Request) -> web.StreamResponse:
 
 @web.middleware
 async def request_id_middleware(request: web.Request, handler):
-    rid = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
+    ctx: AppContext = request.app["ctx"]
+    rid = request.headers.get("X-Request-Id")
+    if not rid:
+        # extra accepted id headers (CLI --request-id-headers)
+        for h in ctx.request_id_headers:
+            rid = request.headers.get(h)
+            if rid:
+                break
+    rid = rid or f"req-{uuid.uuid4().hex[:16]}"
     request["request_id"] = rid
     token = request_id_var.set(rid)
     try:
@@ -293,10 +318,46 @@ async def auth_middleware(request: web.Request, handler):
     except AuthError as e:
         return _error(e.status, e.message, "authentication_error")
     request["principal"] = principal
-    request["tenant"] = (
-        principal.tenant if principal else request.headers.get("X-Tenant-Id", "default")
-    )
+    if principal:
+        request["tenant"] = principal.tenant
+    elif ctx.trust_tenant_header:
+        # CLI --trust-tenant-header / --tenant-header-name
+        request["tenant"] = request.headers.get(ctx.tenant_header, "default")
+    else:
+        request["tenant"] = "default"
     return await handler(request)
+
+
+@web.middleware
+async def limits_middleware(request: web.Request, handler):
+    """--request-timeout-secs + --cors-allowed-origins enforcement."""
+    ctx: AppContext = request.app["ctx"]
+    origin = request.headers.get("Origin")
+    cors_ok = origin and (
+        origin in ctx.cors_allowed_origins or "*" in ctx.cors_allowed_origins
+    )
+    if request.method == "OPTIONS" and cors_ok:
+        return web.Response(status=204, headers={
+            "Access-Control-Allow-Origin": origin,
+            "Access-Control-Allow-Methods": "GET, POST, DELETE, OPTIONS",
+            "Access-Control-Allow-Headers": "authorization, content-type, x-api-key",
+            "Access-Control-Max-Age": "600",
+        })
+    if ctx.request_timeout_secs:
+        try:
+            # wait_for (not asyncio.timeout): pyproject supports py3.10
+            resp = await asyncio.wait_for(
+                handler(request), ctx.request_timeout_secs
+            )
+        except (TimeoutError, asyncio.TimeoutError):
+            if request.get("response_started"):
+                raise  # bytes already out: the connection just dies
+            return _error(408, "request timed out", "timeout_error")
+    else:
+        resp = await handler(request)
+    if cors_ok:
+        resp.headers["Access-Control-Allow-Origin"] = origin
+    return resp
 
 
 @web.middleware
@@ -383,12 +444,14 @@ async def _run_preemptable(ctx, request, handler, guard, priority: str):
             requeues += 1
 
 
-def build_app(ctx: AppContext) -> web.Application:
+def build_app(ctx: AppContext, client_max_size: int = 256 * 2**20) -> web.Application:
     app = web.Application(
         middlewares=[
             request_id_middleware, otel_middleware, error_middleware,
-            plugin_middleware, auth_middleware, admission_middleware,
-        ]
+            limits_middleware, plugin_middleware, auth_middleware,
+            admission_middleware,
+        ],
+        client_max_size=client_max_size,
     )
     app["ctx"] = ctx
 

@@ -27,14 +27,27 @@ class CircuitState(enum.Enum):
 
 class CircuitBreaker:
     """Three-state breaker: CLOSED -> (N consecutive failures) -> OPEN ->
-    (cooldown) -> HALF_OPEN -> (M consecutive successes) -> CLOSED."""
+    (cooldown) -> HALF_OPEN -> (M consecutive successes) -> CLOSED.
+
+    Class-level defaults are the CLI knobs (--cb-*): set once at launch,
+    they apply to every subsequently created worker."""
+
+    DEFAULT_FAILURE_THRESHOLD = 5
+    DEFAULT_SUCCESS_THRESHOLD = 2
+    DEFAULT_COOLDOWN_SECS = 30.0
 
     def __init__(
         self,
-        failure_threshold: int = 5,
-        success_threshold: int = 2,
-        cooldown_secs: float = 30.0,
+        failure_threshold: int | None = None,
+        success_threshold: int | None = None,
+        cooldown_secs: float | None = None,
     ):
+        if failure_threshold is None:
+            failure_threshold = self.DEFAULT_FAILURE_THRESHOLD
+        if success_threshold is None:
+            success_threshold = self.DEFAULT_SUCCESS_THRESHOLD
+        if cooldown_secs is None:
+            cooldown_secs = self.DEFAULT_COOLDOWN_SECS
         self.failure_threshold = failure_threshold
         self.success_threshold = success_threshold
         self.cooldown_secs = cooldown_secs
