@@ -1,0 +1,359 @@
+// Package smgtpu provides a Go SDK for the smg-tpu gateway HTTP API,
+// in the style of OpenAI's Go SDK (reference parity:
+// bindings/golang/client.go in the upstream project — that SDK wraps the
+// gRPC worker protocol via a Rust cdylib; this one speaks the gateway's
+// OpenAI-compatible HTTP surface with zero dependencies, which is the
+// TPU-native deployment's front door).
+//
+// Basic usage:
+//
+//	client := smgtpu.NewClient(smgtpu.ClientConfig{BaseURL: "http://localhost:30000"})
+//	resp, err := client.CreateChatCompletion(ctx, smgtpu.ChatCompletionRequest{
+//		Model:    "default",
+//		Messages: []smgtpu.ChatMessage{{Role: "user", Content: "Hello"}},
+//	})
+//
+// For streaming, use CreateChatCompletionStream and iterate stream.Recv().
+package smgtpu
+
+import (
+	"bufio"
+	"bytes"
+	"context"
+	"encoding/json"
+	"fmt"
+	"io"
+	"net/http"
+	"strings"
+	"time"
+)
+
+// ClientConfig configures a Client.
+type ClientConfig struct {
+	// BaseURL of the gateway, e.g. "http://localhost:30000".
+	BaseURL string
+	// APIKey is sent as a Bearer token when set.
+	APIKey string
+	// HTTPClient overrides the default client (30 min timeout).
+	HTTPClient *http.Client
+}
+
+// Client is a thread-safe gateway client.
+type Client struct {
+	baseURL string
+	apiKey  string
+	http    *http.Client
+}
+
+// NewClient builds a Client; BaseURL defaults to http://localhost:30000.
+func NewClient(cfg ClientConfig) *Client {
+	base := strings.TrimRight(cfg.BaseURL, "/")
+	if base == "" {
+		base = "http://localhost:30000"
+	}
+	hc := cfg.HTTPClient
+	if hc == nil {
+		hc = &http.Client{Timeout: 30 * time.Minute}
+	}
+	return &Client{baseURL: base, apiKey: cfg.APIKey, http: hc}
+}
+
+// ---- wire types (mirror smg_tpu/protocols/openai.py) ----
+
+type ChatMessage struct {
+	Role             string      `json:"role"`
+	Content          interface{} `json:"content,omitempty"`
+	ReasoningContent string      `json:"reasoning_content,omitempty"`
+	ToolCalls        []ToolCall  `json:"tool_calls,omitempty"`
+	ToolCallID       string      `json:"tool_call_id,omitempty"`
+}
+
+type Function struct {
+	Name        string      `json:"name"`
+	Description string      `json:"description,omitempty"`
+	Parameters  interface{} `json:"parameters,omitempty"`
+}
+
+type Tool struct {
+	Type     string   `json:"type"`
+	Function Function `json:"function"`
+}
+
+type FunctionCall struct {
+	Name      string `json:"name,omitempty"`
+	Arguments string `json:"arguments,omitempty"`
+}
+
+type ToolCall struct {
+	ID       string       `json:"id,omitempty"`
+	Type     string       `json:"type,omitempty"`
+	Index    *int         `json:"index,omitempty"`
+	Function FunctionCall `json:"function"`
+}
+
+type ChatCompletionRequest struct {
+	Model       string        `json:"model,omitempty"`
+	Messages    []ChatMessage `json:"messages"`
+	MaxTokens   *int          `json:"max_tokens,omitempty"`
+	Temperature *float64      `json:"temperature,omitempty"`
+	TopP        *float64      `json:"top_p,omitempty"`
+	Stop        []string      `json:"stop,omitempty"`
+	Tools       []Tool        `json:"tools,omitempty"`
+	Stream      bool          `json:"stream,omitempty"`
+}
+
+type Usage struct {
+	PromptTokens     int `json:"prompt_tokens"`
+	CompletionTokens int `json:"completion_tokens"`
+	TotalTokens      int `json:"total_tokens"`
+}
+
+type Choice struct {
+	Index        int         `json:"index"`
+	Message      ChatMessage `json:"message"`
+	FinishReason string      `json:"finish_reason"`
+}
+
+type ChatCompletionResponse struct {
+	ID      string   `json:"id"`
+	Model   string   `json:"model"`
+	Choices []Choice `json:"choices"`
+	Usage   *Usage   `json:"usage,omitempty"`
+}
+
+type StreamDelta struct {
+	Role             string     `json:"role,omitempty"`
+	Content          string     `json:"content,omitempty"`
+	ReasoningContent string     `json:"reasoning_content,omitempty"`
+	ToolCalls        []ToolCall `json:"tool_calls,omitempty"`
+}
+
+type StreamChoice struct {
+	Index        int         `json:"index"`
+	Delta        StreamDelta `json:"delta"`
+	FinishReason *string     `json:"finish_reason,omitempty"`
+}
+
+type ChatCompletionStreamResponse struct {
+	ID      string         `json:"id"`
+	Model   string         `json:"model"`
+	Choices []StreamChoice `json:"choices"`
+	Usage   *Usage         `json:"usage,omitempty"`
+}
+
+// GenerateRequest is the native /generate surface (SGLang-compatible).
+type GenerateRequest struct {
+	Text           string                 `json:"text,omitempty"`
+	InputIDs       []int                  `json:"input_ids,omitempty"`
+	SamplingParams map[string]interface{} `json:"sampling_params,omitempty"`
+	Stream         bool                   `json:"stream,omitempty"`
+	RID            string                 `json:"rid,omitempty"`
+}
+
+type GenerateResponse struct {
+	Text      string                 `json:"text"`
+	OutputIDs []int                  `json:"output_ids"`
+	MetaInfo  map[string]interface{} `json:"meta_info"`
+}
+
+// WorkerSpec registers a worker (POST /workers).
+type WorkerSpec struct {
+	URL           string `json:"url"`
+	WorkerType    string `json:"worker_type,omitempty"` // regular|prefill|decode|encode
+	ModelID       string `json:"model_id,omitempty"`
+	BootstrapHost string `json:"bootstrap_host,omitempty"`
+	BootstrapPort *int   `json:"bootstrap_port,omitempty"`
+}
+
+// APIError is a non-2xx gateway reply.
+type APIError struct {
+	StatusCode int
+	Type       string `json:"type"`
+	Message    string `json:"message"`
+}
+
+func (e *APIError) Error() string {
+	return fmt.Sprintf("smg-tpu: %d %s: %s", e.StatusCode, e.Type, e.Message)
+}
+
+// ---- plumbing ----
+
+func (c *Client) do(ctx context.Context, method, path string, body, out interface{}) error {
+	var rd io.Reader
+	if body != nil {
+		buf, err := json.Marshal(body)
+		if err != nil {
+			return err
+		}
+		rd = bytes.NewReader(buf)
+	}
+	req, err := http.NewRequestWithContext(ctx, method, c.baseURL+path, rd)
+	if err != nil {
+		return err
+	}
+	req.Header.Set("Content-Type", "application/json")
+	if c.apiKey != "" {
+		req.Header.Set("Authorization", "Bearer "+c.apiKey)
+	}
+	resp, err := c.http.Do(req)
+	if err != nil {
+		return err
+	}
+	defer resp.Body.Close()
+	data, err := io.ReadAll(resp.Body)
+	if err != nil {
+		return err
+	}
+	if resp.StatusCode/100 != 2 {
+		return parseAPIError(resp.StatusCode, data)
+	}
+	if out != nil {
+		return json.Unmarshal(data, out)
+	}
+	return nil
+}
+
+func parseAPIError(status int, data []byte) error {
+	var wrapper struct {
+		Error APIError `json:"error"`
+	}
+	if json.Unmarshal(data, &wrapper) == nil && wrapper.Error.Message != "" {
+		wrapper.Error.StatusCode = status
+		return &wrapper.Error
+	}
+	return &APIError{StatusCode: status, Type: "http_error", Message: string(data)}
+}
+
+func (c *Client) stream(ctx context.Context, path string, body interface{}) (*SSEStream, error) {
+	buf, err := json.Marshal(body)
+	if err != nil {
+		return nil, err
+	}
+	req, err := http.NewRequestWithContext(ctx, http.MethodPost, c.baseURL+path, bytes.NewReader(buf))
+	if err != nil {
+		return nil, err
+	}
+	req.Header.Set("Content-Type", "application/json")
+	req.Header.Set("Accept", "text/event-stream")
+	if c.apiKey != "" {
+		req.Header.Set("Authorization", "Bearer "+c.apiKey)
+	}
+	resp, err := c.http.Do(req)
+	if err != nil {
+		return nil, err
+	}
+	if resp.StatusCode/100 != 2 {
+		data, _ := io.ReadAll(resp.Body)
+		resp.Body.Close()
+		return nil, parseAPIError(resp.StatusCode, data)
+	}
+	return &SSEStream{body: resp.Body, scanner: bufio.NewScanner(resp.Body)}, nil
+}
+
+// SSEStream iterates "data:" frames of a server-sent-event response.
+type SSEStream struct {
+	body    io.ReadCloser
+	scanner *bufio.Scanner
+}
+
+// RecvRaw returns the next data payload, or io.EOF after [DONE]/close.
+func (s *SSEStream) RecvRaw() ([]byte, error) {
+	for s.scanner.Scan() {
+		line := strings.TrimSpace(s.scanner.Text())
+		if !strings.HasPrefix(line, "data:") {
+			continue
+		}
+		payload := strings.TrimSpace(strings.TrimPrefix(line, "data:"))
+		if payload == "[DONE]" {
+			return nil, io.EOF
+		}
+		return []byte(payload), nil
+	}
+	if err := s.scanner.Err(); err != nil {
+		return nil, err
+	}
+	return nil, io.EOF
+}
+
+// Close releases the underlying connection.
+func (s *SSEStream) Close() error { return s.body.Close() }
+
+// ChatCompletionStream wraps SSEStream with typed chunks.
+type ChatCompletionStream struct{ *SSEStream }
+
+// Recv returns the next chunk, or io.EOF at end of stream.
+func (s *ChatCompletionStream) Recv() (*ChatCompletionStreamResponse, error) {
+	raw, err := s.RecvRaw()
+	if err != nil {
+		return nil, err
+	}
+	var chunk ChatCompletionStreamResponse
+	if err := json.Unmarshal(raw, &chunk); err != nil {
+		return nil, err
+	}
+	return &chunk, nil
+}
+
+// ---- API surface ----
+
+// CreateChatCompletion performs a non-streaming chat completion.
+func (c *Client) CreateChatCompletion(ctx context.Context, req ChatCompletionRequest) (*ChatCompletionResponse, error) {
+	req.Stream = false
+	var out ChatCompletionResponse
+	if err := c.do(ctx, http.MethodPost, "/v1/chat/completions", req, &out); err != nil {
+		return nil, err
+	}
+	return &out, nil
+}
+
+// CreateChatCompletionStream opens a streaming chat completion.
+func (c *Client) CreateChatCompletionStream(ctx context.Context, req ChatCompletionRequest) (*ChatCompletionStream, error) {
+	req.Stream = true
+	s, err := c.stream(ctx, "/v1/chat/completions", req)
+	if err != nil {
+		return nil, err
+	}
+	return &ChatCompletionStream{s}, nil
+}
+
+// Generate calls the native /generate endpoint (non-streaming).
+func (c *Client) Generate(ctx context.Context, req GenerateRequest) (*GenerateResponse, error) {
+	req.Stream = false
+	var out GenerateResponse
+	if err := c.do(ctx, http.MethodPost, "/generate", req, &out); err != nil {
+		return nil, err
+	}
+	return &out, nil
+}
+
+// Health probes the gateway.
+func (c *Client) Health(ctx context.Context) error {
+	return c.do(ctx, http.MethodGet, "/health", nil, nil)
+}
+
+// ListModels returns the served model ids.
+func (c *Client) ListModels(ctx context.Context) ([]string, error) {
+	var out struct {
+		Data []struct {
+			ID string `json:"id"`
+		} `json:"data"`
+	}
+	if err := c.do(ctx, http.MethodGet, "/v1/models", nil, &out); err != nil {
+		return nil, err
+	}
+	ids := make([]string, 0, len(out.Data))
+	for _, m := range out.Data {
+		ids = append(ids, m.ID)
+	}
+	return ids, nil
+}
+
+// AddWorker registers a worker with the gateway.
+func (c *Client) AddWorker(ctx context.Context, spec WorkerSpec) error {
+	return c.do(ctx, http.MethodPost, "/workers", spec, nil)
+}
+
+// RemoveWorker drains and removes a worker.
+func (c *Client) RemoveWorker(ctx context.Context, workerID string) error {
+	return c.do(ctx, http.MethodDelete, "/workers/"+workerID, nil, nil)
+}

@@ -1,0 +1,37 @@
+// Minimal streaming chat example against a local gateway.
+package main
+
+import (
+	"context"
+	"fmt"
+	"io"
+	"log"
+
+	smgtpu "github.com/smg-tpu/smg-tpu/bindings/golang"
+)
+
+func main() {
+	client := smgtpu.NewClient(smgtpu.ClientConfig{BaseURL: "http://localhost:30000"})
+	stream, err := client.CreateChatCompletionStream(context.Background(),
+		smgtpu.ChatCompletionRequest{
+			Model:    "default",
+			Messages: []smgtpu.ChatMessage{{Role: "user", Content: "Hello!"}},
+		})
+	if err != nil {
+		log.Fatal(err)
+	}
+	defer stream.Close()
+	for {
+		chunk, err := stream.Recv()
+		if err == io.EOF {
+			break
+		}
+		if err != nil {
+			log.Fatal(err)
+		}
+		for _, c := range chunk.Choices {
+			fmt.Print(c.Delta.Content)
+		}
+	}
+	fmt.Println()
+}

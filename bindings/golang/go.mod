@@ -1,0 +1,3 @@
+module github.com/smg-tpu/smg-tpu/bindings/golang
+
+go 1.21

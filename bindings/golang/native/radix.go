@@ -1,0 +1,76 @@
+// Package native exposes libsmg_native's C ABI to Go via cgo
+// (reference parity: bindings/golang/src/lib.rs — the upstream wraps its
+// Rust router core as a cdylib; here the native core is the C++ radix
+// prefix index in csrc/, shared with the Python ctypes loader).
+//
+// Build: `make -C ../../csrc` first (produces libsmg_native.so), then
+// `go build` with CGO_ENABLED=1.
+package native
+
+/*
+#cgo CFLAGS: -I${SRCDIR}/../../../csrc
+#cgo LDFLAGS: -L${SRCDIR}/../../../csrc -lsmg_native -Wl,-rpath,${SRCDIR}/../../../csrc
+#include <stdlib.h>
+#include "smg_native.h"
+*/
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+// RadixTree is a prefix index over token sequences mapping cached
+// prefixes to worker ids (cache-aware routing's core structure).
+type RadixTree struct {
+	ptr unsafe.Pointer
+}
+
+// NewRadixTree allocates a tree bounded to maxSize nodes.
+func NewRadixTree(maxSize int) *RadixTree {
+	t := &RadixTree{ptr: C.rt_new(C.size_t(maxSize))}
+	runtime.SetFinalizer(t, func(t *RadixTree) { t.Close() })
+	return t
+}
+
+// Close frees the native tree (idempotent).
+func (t *RadixTree) Close() {
+	if t.ptr != nil {
+		C.rt_free(t.ptr)
+		t.ptr = nil
+	}
+}
+
+// Insert records that `worker` holds the KV for `tokens`.
+func (t *RadixTree) Insert(tokens []uint32, worker uint32) {
+	if len(tokens) == 0 {
+		return
+	}
+	C.rt_insert(t.ptr, (*C.uint32_t)(unsafe.Pointer(&tokens[0])),
+		C.size_t(len(tokens)), C.uint32_t(worker))
+}
+
+// Match returns (workerID, matchedPrefixLen) pairs for `tokens`,
+// best match first, up to cap entries.
+func (t *RadixTree) Match(tokens []uint32, capHint int) (workers []uint32, lens []uint32) {
+	if len(tokens) == 0 || capHint <= 0 {
+		return nil, nil
+	}
+	workers = make([]uint32, capHint)
+	lens = make([]uint32, capHint)
+	n := C.rt_match(t.ptr, (*C.uint32_t)(unsafe.Pointer(&tokens[0])),
+		C.size_t(len(tokens)),
+		(*C.uint32_t)(unsafe.Pointer(&workers[0])),
+		(*C.uint32_t)(unsafe.Pointer(&lens[0])), C.size_t(capHint))
+	return workers[:n], lens[:n]
+}
+
+// RemoveWorker drops every entry owned by `worker` (worker death).
+func (t *RadixTree) RemoveWorker(worker uint32) {
+	C.rt_remove_worker(t.ptr, C.uint32_t(worker))
+}
+
+// Size reports the live node count.
+func (t *RadixTree) Size() int {
+	return int(C.rt_size(t.ptr))
+}

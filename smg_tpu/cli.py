@@ -244,7 +244,10 @@ def main(argv: list[str] | None = None) -> int:
               json_logs=getattr(args, "log_json", False) or None)
     # validate before any port binds or chip touches (reference:
     # ConfigValidator::validate at startup, config/validation.rs)
-    if args.command in ("launch", "serve"):
+    if args.command in ("launch", "serve", "worker"):
+        # worker mode validates too: the engine-flag rules (draft model
+        # without --speculative etc.) apply to the bare engine as well, and
+        # the gateway-only checks no-op on absent fields
         from smg_tpu.config.validation import raise_on_errors, validate_cli_args
         from smg_tpu.utils import get_logger
 

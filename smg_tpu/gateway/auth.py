@@ -38,7 +38,8 @@ class AuthConfig:
     jwt_secret: str | None = None  # enables HS256 bearer verification
     jwks: "JwksVerifier | None" = None  # enables RS256/OIDC bearer verification
     # routes that skip auth (probes)
-    public_paths: tuple[str, ...] = ("/health", "/liveness", "/readiness", "/metrics")
+    public_paths: tuple[str, ...] = ("/health", "/liveness", "/readiness",
+                                     "/metrics")
 
 
 class AuthError(Exception):
@@ -143,16 +144,45 @@ class JwksVerifier:
     def _cooled(self) -> bool:
         return time.monotonic() - self._last_attempt > self.min_refresh_interval
 
+    def _refresh_background(self) -> None:
+        """Off-request refresh: a slow IdP must not stall the event loop
+        (the fetcher may be blocking I/O).  One thread at a time."""
+        import threading
+
+        if getattr(self, "_refreshing", False):
+            return
+        self._refreshing = True
+        self._last_attempt = time.monotonic()
+
+        def run():
+            try:
+                self._refresh()
+            except Exception as e:
+                logger.warning("JWKS background refresh failed: %s", e)
+            finally:
+                self._refreshing = False
+
+        threading.Thread(target=run, daemon=True, name="jwks-refresh").start()
+
     def _key_for(self, kid: str) -> "tuple[int, int] | None":
         now = time.monotonic()
         stale = not self._keys or now - self._fetched_at > self.cache_ttl
         if stale and self._cooled():
-            try:
-                self._refresh()
-            except Exception as e:
-                logger.warning("JWKS fetch failed: %s", e)
+            if self._keys:
+                # serve the cached keys; refresh off-loop (TTL expiry must
+                # not block the request on IdP latency)
+                self._refresh_background()
+            else:
+                # cold start: nothing to serve yet — this one blocks
+                try:
+                    self._refresh()
+                except Exception as e:
+                    logger.warning("JWKS fetch failed: %s", e)
         if kid not in self._keys and self._cooled():
-            # rotation: the IdP may have published a new key since our cache
+            # rotation: the IdP may have published a new key since our
+            # cache.  SYNCHRONOUS on purpose — the newly rotated token must
+            # verify on its first presentation; the cooldown bounds how
+            # often unknown kids can force this blocking fetch
             try:
                 self._refresh()
             except Exception as e:
@@ -211,6 +241,12 @@ class Authenticator:
         """Returns the principal, or None when auth is disabled/public.
         Raises AuthError when credentials are missing/invalid."""
         if not self.config.enabled or path in self.config.public_paths:
+            return None
+        if path.startswith("/v1/realtime") and path != "/v1/realtime/client_secrets":
+            # realtime WS handshakes enforce their own credential check
+            # in-handler (ephemeral client secrets ride the query string —
+            # browsers can't set WS headers); minting a secret still
+            # authenticates normally
             return None
         authz = headers.get("Authorization", "")
         api_key = headers.get("X-API-Key") or (

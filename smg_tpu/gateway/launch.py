@@ -225,15 +225,14 @@ async def _run_gateway(args) -> int:
         failure_threshold=getattr(args, "health_failure_threshold", 3),
         success_threshold=getattr(args, "health_success_threshold", 2),
     )
-    # circuit-breaker defaults apply to every subsequently created Worker
-    from smg_tpu.gateway.workers import CircuitBreaker
-
-    CircuitBreaker.DEFAULT_FAILURE_THRESHOLD = (
-        10**9 if getattr(args, "disable_circuit_breaker", False)
-        else getattr(args, "cb_failure_threshold", 5)
+    # circuit-breaker knobs are PER-CONTEXT (two gateways in one process
+    # keep their own settings): applied to workers as the registry adds them
+    cb_config = (
+        (10**9 if getattr(args, "disable_circuit_breaker", False)
+         else getattr(args, "cb_failure_threshold", 5)),
+        getattr(args, "cb_success_threshold", 2),
+        getattr(args, "cb_timeout_duration_secs", 30.0),
     )
-    CircuitBreaker.DEFAULT_SUCCESS_THRESHOLD = getattr(args, "cb_success_threshold", 2)
-    CircuitBreaker.DEFAULT_COOLDOWN_SECS = getattr(args, "cb_timeout_duration_secs", 30.0)
     ctx = AppContext(
         policy=args.policy,
         router_config=router_config,
@@ -254,6 +253,7 @@ async def _run_gateway(args) -> int:
                              or auth_config is None),
         request_timeout_secs=getattr(args, "request_timeout_secs", None),
         cors_allowed_origins=list(getattr(args, "cors_allowed_origins", []) or []),
+        circuit_breaker_config=cb_config,
     )
     if getattr(args, "mcp_config_path", None):
         import json as _json
@@ -404,13 +404,22 @@ async def _run_gateway(args) -> int:
     await site.start()
     logger.info("gateway listening on %s:%d%s", args.host, args.port,
                 " (TLS)" if ssl_ctx else "")
-    probe_site = None
+    probe_runner = None
     if getattr(args, "health_check_port", None):
         # dedicated probe listener: /health /liveness /readiness stay
         # reachable even when the main port saturates (reference:
-        # --health-check-port's isolated probe runtime)
-        probe_site = web.TCPSite(runner, args.host, args.health_check_port)
-        await probe_site.start()
+        # --health-check-port's isolated probe runtime).  PROBE-ONLY app:
+        # the full API must not leak onto an unauthenticated/plaintext port
+        from smg_tpu.gateway.server import h_health, h_readiness
+
+        papp = web.Application()
+        papp["ctx"] = ctx
+        papp.router.add_get("/health", h_health)
+        papp.router.add_get("/liveness", h_health)
+        papp.router.add_get("/readiness", h_readiness)
+        probe_runner = web.AppRunner(papp)
+        await probe_runner.setup()
+        await web.TCPSite(probe_runner, args.host, args.health_check_port).start()
         logger.info("probe listener on %s:%d", args.host, args.health_check_port)
     metrics_runner = None
     if getattr(args, "prometheus_port", None):
@@ -441,5 +450,7 @@ async def _run_gateway(args) -> int:
             await mesh_node.stop()
         if metrics_runner is not None:
             await metrics_runner.cleanup()
+        if probe_runner is not None:
+            await probe_runner.cleanup()
         await runner.cleanup()
     return 0

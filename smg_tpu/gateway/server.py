@@ -57,6 +57,7 @@ class AppContext:
         trust_tenant_header: bool | None = None,
         request_timeout_secs: float | None = None,
         cors_allowed_origins: list | None = None,
+        circuit_breaker_config: tuple | None = None,
     ):
         from smg_tpu.gateway.auth import AuthConfig, Authenticator
         from smg_tpu.gateway.health import HealthMonitor
@@ -67,6 +68,7 @@ class AppContext:
         from smg_tpu.gateway.providers import ProviderRegistry
 
         self.registry = WorkerRegistry()
+        self.registry.circuit_breaker_config = circuit_breaker_config
         self.policies = PolicyRegistry(default=policy, **(policy_kwargs or {}))
         self.providers = ProviderRegistry()
         self.tokenizers = TokenizerRegistry()
@@ -343,7 +345,10 @@ async def limits_middleware(request: web.Request, handler):
             "Access-Control-Allow-Headers": "authorization, content-type, x-api-key",
             "Access-Control-Max-Age": "600",
         })
-    if ctx.request_timeout_secs:
+    is_ws = request.headers.get("Upgrade", "").lower() == "websocket"
+    if ctx.request_timeout_secs and not is_ws:
+        # websocket sessions (realtime/relay) are long-lived by design —
+        # the request timeout governs HTTP request/response cycles only
         try:
             # wait_for (not asyncio.timeout): pyproject supports py3.10
             resp = await asyncio.wait_for(
@@ -505,9 +510,15 @@ def build_app(ctx: AppContext, client_max_size: int = 256 * 2**20) -> web.Applic
     app.router.add_post("/parse/reasoning", h_parse_reasoning)
     app.router.add_post("/v1/tokenize", h_tokenize)
     app.router.add_post("/v1/detokenize", h_detokenize)
-    from smg_tpu.gateway.realtime import handle_realtime
+    from smg_tpu.gateway.realtime import (
+        h_realtime_client_secrets,
+        handle_realtime,
+        handle_realtime_relay,
+    )
 
     app.router.add_get("/v1/realtime", handle_realtime)
+    app.router.add_post("/v1/realtime/client_secrets", h_realtime_client_secrets)
+    app.router.add_get("/v1/realtime/relay/{session_id}", handle_realtime_relay)
     app.router.add_post("/v1/responses", h_responses_create)
     app.router.add_get("/v1/responses/{response_id}", h_responses_get)
     app.router.add_delete("/v1/responses/{response_id}", h_responses_delete)

@@ -29,8 +29,8 @@ class CircuitBreaker:
     """Three-state breaker: CLOSED -> (N consecutive failures) -> OPEN ->
     (cooldown) -> HALF_OPEN -> (M consecutive successes) -> CLOSED.
 
-    Class-level defaults are the CLI knobs (--cb-*): set once at launch,
-    they apply to every subsequently created worker."""
+    CLI knobs (--cb-*) flow per-registry (WorkerRegistry.
+    circuit_breaker_config), applied as workers register."""
 
     DEFAULT_FAILURE_THRESHOLD = 5
     DEFAULT_SUCCESS_THRESHOLD = 2
@@ -209,8 +209,13 @@ class WorkerRegistry:
         self._workers: dict[str, Worker] = {}
         self._lock = threading.Lock()
         self._listeners: list[Callable[[str, Worker], None]] = []
+        # per-REGISTRY breaker defaults (CLI --cb-*): applied as workers
+        # register, so two gateways in one process keep their own settings
+        self.circuit_breaker_config: "tuple | None" = None
 
     def add(self, worker: Worker) -> None:
+        if self.circuit_breaker_config is not None:
+            worker.circuit = CircuitBreaker(*self.circuit_breaker_config)
         with self._lock:
             if worker.worker_id in self._workers:
                 raise ValueError(f"worker {worker.worker_id} already registered")

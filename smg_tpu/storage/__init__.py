@@ -38,6 +38,16 @@ def make_storage(spec: str | None):
         from smg_tpu.storage.postgres import PostgresStorage
 
         return PostgresStorage(dsn=spec)
+    if spec.startswith("oracle://"):
+        from urllib.parse import urlparse
+
+        from smg_tpu.storage.oracle import OracleStorage, connect_oracle
+
+        u = urlparse(spec)
+        dsn = f"{u.hostname}:{u.port or 1521}/{(u.path or '/').lstrip('/')}"
+        return OracleStorage(connect_oracle(
+            dsn, user=u.username or "", password=u.password or ""
+        ))
     raise ValueError(f"unknown storage spec {spec!r}")
 
 
