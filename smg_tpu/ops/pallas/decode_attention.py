@@ -41,6 +41,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# pl.ANY replaced pltpu.ANY in newer jax; accept either
+_ANY = getattr(pl, "ANY", None) or pltpu.ANY
 
 
 def _decode_kernel(
@@ -218,8 +220,8 @@ def paged_attention_decode_cached(
             pl.BlockSpec((1, H, KD), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec((1, N, KD), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec((1, N, KD), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=_ANY),
+            pl.BlockSpec(memory_space=_ANY),
         ],
         out_specs=pl.BlockSpec((1, H, KD), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[

@@ -39,6 +39,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# pl.ANY replaced pltpu.ANY in newer jax; accept either
+_ANY = getattr(pl, "ANY", None) or pltpu.ANY
 
 
 def _prefill_kernel(
@@ -243,8 +245,8 @@ def paged_attention_prefill(
             pl.BlockSpec((1, R, CD), lambda p, *_: (p, 0, 0)),
             pl.BlockSpec((1, T, CD), lambda p, *_: (p, 0, 0)),
             pl.BlockSpec((1, T, CD), lambda p, *_: (p, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=_ANY),
+            pl.BlockSpec(memory_space=_ANY),
         ],
         out_specs=pl.BlockSpec((1, R, CD), lambda p, *_: (p, 0, 0)),
         scratch_shapes=[
