@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Metric-name drift check.
+
+Builds the full gateway + engine metric set on a fresh registry, scrapes the
+Prometheus exposition, and asserts:
+
+1. every registered ``smg_*`` family appears exactly once (no duplicate
+   registration between ``gateway/observability.py`` and
+   ``engine/metrics.py``);
+2. every exported family is listed in the README observability table, and the
+   table names nothing that is no longer exported (docs drift both ways).
+
+Run directly (CI) or through ``tests/test_observability.py``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DOC_FILES = [REPO_ROOT / "README.md"]
+
+if str(REPO_ROOT) not in sys.path:  # runnable directly: scripts/check_metric_docs.py
+    sys.path.insert(0, str(REPO_ROOT))
+
+
+def exported_families() -> dict[str, int]:
+    """{family_name: occurrences} from a fresh unified registry's exposition.
+
+    Family names are taken from ``# TYPE`` lines — present even for labeled
+    metrics with no children yet — and match the text-format convention
+    (counters carry the ``_total`` suffix).
+    """
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from smg_tpu.engine.metrics import EngineMetrics
+    from smg_tpu.gateway.observability import Metrics
+
+    registry = CollectorRegistry()
+    Metrics(registry=registry)
+    EngineMetrics().register_into(registry)
+    counts: dict[str, int] = {}
+    for line in generate_latest(registry).decode().splitlines():
+        m = re.match(r"# TYPE (smg_\w+) ", line)
+        # `_created` companions are prometheus_client bookkeeping emitted
+        # alongside every counter/histogram, not families operators consume
+        if m and not m.group(1).endswith("_created"):
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def documented_families() -> set[str]:
+    """``smg_*`` names from the docs' metric TABLE rows only — a backticked
+    mention in prose must not satisfy the check the table exists for."""
+    names: set[str] = set()
+    for doc in DOC_FILES:
+        if not doc.exists():
+            continue
+        for line in doc.read_text().splitlines():
+            m = re.match(r"\|\s*`(smg_\w+)`\s*\|", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def check() -> list[str]:
+    """Returns a list of human-readable drift errors (empty = clean)."""
+    errors: list[str] = []
+    counts = exported_families()
+    if not counts:
+        return ["no smg_* families exported at all (registry wiring broken?)"]
+    for name, n in sorted(counts.items()):
+        if n != 1:
+            errors.append(f"family {name} exported {n} times (expected exactly once)")
+    docs = documented_families()
+    for name in sorted(counts):
+        if name not in docs:
+            errors.append(f"family {name} is exported but missing from the docs table")
+    for name in sorted(docs - set(counts)):
+        errors.append(f"docs table lists {name}, which is no longer exported")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"DRIFT: {e}", file=sys.stderr)
+    if not errors:
+        print(f"ok: {len(exported_families())} smg_* families, docs in sync")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,6 +234,14 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--max-batch-size", type=int, default=64)
     g.add_argument("--max-seq-len", type=int, default=8192)
     g.add_argument("--page-size", type=int, default=16)
+    g.add_argument("--metrics-window-secs", type=float, default=30.0,
+                   dest="metrics_window_secs",
+                   help="rolling-stats horizon for engine step telemetry "
+                        "(p50/p95 step time, tokens/s via /scheduler)")
+    g.add_argument("--device-metrics-interval-secs", type=float, default=10.0,
+                   dest="device_metrics_interval_secs",
+                   help="cadence for HBM gauges from device.memory_stats() "
+                        "(0 disables device sampling)")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -13,5 +13,13 @@ from smg_tpu.engine.config import (
     ParallelConfig,
     SchedulerConfig,
 )
+from smg_tpu.engine.metrics import EngineMetrics, RollingStepStats
 
-__all__ = ["CacheConfig", "EngineConfig", "ParallelConfig", "SchedulerConfig"]
+__all__ = [
+    "CacheConfig",
+    "EngineConfig",
+    "EngineMetrics",
+    "ParallelConfig",
+    "RollingStepStats",
+    "SchedulerConfig",
+]

@@ -135,6 +135,11 @@ class EngineConfig:
     # greedy proposals replace n-gram lookup; None = prompt-lookup drafting
     draft_model: "object" = None
     draft_seed: int = 1
+    # engine-deep observability (engine/metrics.py): rolling-stats horizon
+    # surfaced via loads()/the /scheduler endpoint, and the cadence for
+    # device.memory_stats() HBM gauges (0 disables device sampling)
+    metrics_window_secs: float = 30.0
+    device_metrics_interval_secs: float = 10.0
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

@@ -68,7 +68,19 @@ class Engine:
         self.tokenizer = tokenizer
         self.events = KvEventPublisher()
         self.runner = ModelRunner(config, params=params, devices=devices)
-        self.scheduler = Scheduler(self.runner, config, event_sink=self.events.publish)
+        # engine-deep metric set (own registry; the gateway additionally
+        # registers it into its CollectorRegistry so /metrics is one scrape)
+        from smg_tpu.engine.metrics import EngineMetrics
+
+        self.metrics = EngineMetrics(
+            window_secs=config.metrics_window_secs,
+            device_sample_interval_secs=config.device_metrics_interval_secs,
+        )
+        self._metric_devices: list | None = None  # built lazily, once
+        self.scheduler = Scheduler(
+            self.runner, config, event_sink=self.events.publish,
+            metrics=self.metrics,
+        )
         if config.draft_model is not None and self.runner.mesh is None:
             from smg_tpu.engine.draft import DraftRunner
 
@@ -479,6 +491,14 @@ class Engine:
             step_outs = self.scheduler.step()
             outputs = [self._postprocess(so) for so in step_outs]
             self.events.flush()
+            if self.config.device_metrics_interval_secs > 0:
+                # cadence-gated HBM gauges (no-op between samples; CPU
+                # devices report no memory_stats and are skipped).  The
+                # device set is fixed for the engine's lifetime — build the
+                # list once, not on every step of the hot loop.
+                if self._metric_devices is None:
+                    self._metric_devices = self.runner.local_devices()
+                self.metrics.maybe_sample_devices(self._metric_devices)
             if getattr(self, "_profile_steps_left", None) is not None:
                 self._profile_steps_left -= 1
                 if self._profile_steps_left <= 0:

@@ -1,0 +1,340 @@
+"""Engine-deep metrics: step-loop telemetry below the HTTP layer.
+
+Reference: ``model_gateway/src/observability/`` — the reference gateway ships
+45 ``record_*`` metric functions and exports engine counters (batch occupancy,
+cache hit rate, token throughput) through one Prometheus registry.  The
+gateway-level twin lives in ``smg_tpu/gateway/observability.py``; this module
+covers everything below it: the scheduler step loop, the radix prefix cache,
+the KV page pool, speculative decoding, and JAX device memory.
+
+Design notes:
+
+- ``EngineMetrics`` owns its instruments but can be *additionally* registered
+  into the gateway's ``CollectorRegistry`` (``register_into``) so ``/metrics``
+  exports one coherent ``smg_*`` set — prometheus collectors are registry
+  -agnostic and may belong to several registries at once.
+- The scheduler keeps plain int counters (cheap, lock-free under the engine
+  lock); ``observe_step`` converts their cumulative values into Prometheus
+  counter increments by delta-tracking, so the step loop never touches label
+  lookups for quantities it already counts.
+- Device memory gauges come from ``device.memory_stats()`` — TPU/GPU backends
+  report ``bytes_in_use``/``bytes_limit``; CPU devices raise or return
+  nothing and are skipped (guarded).
+- ``RollingStepStats`` is the live-signal side: p50/p95 step latency and
+  tokens/s over the last N seconds, surfaced through ``Scheduler.loads()``
+  and the gateway's ``/scheduler`` endpoint for the cache-aware router and
+  benchmarks.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+from smg_tpu.utils import get_logger
+
+logger = get_logger("engine.metrics")
+
+# step latencies sit well under the request-level buckets: sub-millisecond
+# decode steps on TPU up to multi-second chunked prefills
+STEP_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
+
+class RollingStepStats:
+    """Fixed-horizon window over step records -> p50/p95 step time, tokens/s.
+
+    Append-only deque pruned on both record and snapshot; bounded by
+    ``max_samples`` so a pathological step rate cannot grow host memory.
+    All callers hold the engine lock, so no extra locking here.
+    """
+
+    def __init__(self, window_secs: float = 30.0, max_samples: int = 8192):
+        self.window_secs = window_secs
+        self.max_samples = max_samples
+        # (monotonic_ts, step_seconds, prefill_tokens, decode_tokens)
+        self._samples: deque[tuple[float, float, int, int]] = deque()
+
+    def record(
+        self, step_seconds: float, prefill_tokens: int, decode_tokens: int,
+        now: float | None = None,
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        self._samples.append((now, step_seconds, prefill_tokens, decode_tokens))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_secs
+        s = self._samples
+        while s and (s[0][0] < horizon or len(s) > self.max_samples):
+            s.popleft()
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """Live stats over the window (keys stable for /scheduler + loads())."""
+        now = time.monotonic() if now is None else now
+        self._prune(now)
+        s = self._samples
+        if not s:
+            return {
+                "window_secs": self.window_secs, "num_steps": 0,
+                "p50_step_seconds": 0.0, "p95_step_seconds": 0.0,
+                "steps_per_s": 0.0, "prefill_tokens_per_s": 0.0,
+                "decode_tokens_per_s": 0.0, "tokens_per_s": 0.0,
+            }
+        durations = sorted(x[1] for x in s)
+        n = len(durations)
+        # effective span: oldest record's age plus that step's own duration
+        # (records are stamped at step END, so the first step's work would
+        # otherwise fall outside the window), floored so a burst of steps in
+        # 1ms doesn't report absurd rates
+        span = max(now - s[0][0] + s[0][1], 1e-3)
+        pf = sum(x[2] for x in s)
+        dc = sum(x[3] for x in s)
+        return {
+            "window_secs": self.window_secs,
+            "num_steps": n,
+            "p50_step_seconds": durations[n // 2],
+            "p95_step_seconds": durations[min(n - 1, (n * 95) // 100)],
+            "steps_per_s": n / span,
+            "prefill_tokens_per_s": pf / span,
+            "decode_tokens_per_s": dc / span,
+            "tokens_per_s": (pf + dc) / span,
+        }
+
+
+class EngineMetrics:
+    """Engine metric set (``smg_engine_*``, same naming scheme as the
+    gateway's ``smg_*`` metrics)."""
+
+    def __init__(
+        self,
+        registry: CollectorRegistry | None = None,
+        window_secs: float = 30.0,
+        device_sample_interval_secs: float = 10.0,
+    ):
+        self.registry = registry or CollectorRegistry()
+        self.window = RollingStepStats(window_secs)
+        self.device_sample_interval_secs = device_sample_interval_secs
+        self._next_device_sample = 0.0
+        self._last: dict[str, int] = {}  # cumulative-counter delta tracking
+        self._collectors: list = []
+        r = self.registry
+
+        def _track(c):
+            self._collectors.append(c)
+            return c
+
+        self.step_duration = _track(Histogram(
+            "smg_engine_step_duration_seconds",
+            "Engine step latency by phase (prefill admission / decode / full step)",
+            ["phase"], buckets=STEP_LATENCY_BUCKETS, registry=r,
+        ))
+        self.prefill_tokens = _track(Counter(
+            "smg_engine_prefill_tokens_total",
+            "Prompt tokens computed by prefill (cache misses; excludes radix hits)",
+            registry=r,
+        ))
+        self.decode_tokens = _track(Counter(
+            "smg_engine_decode_tokens_total",
+            "Tokens produced by decode steps (incl. speculative-accepted)",
+            registry=r,
+        ))
+        self.cached_prompt_tokens = _track(Counter(
+            "smg_engine_cached_prompt_tokens_total",
+            "Prompt tokens served from the radix prefix cache at admission",
+            registry=r,
+        ))
+        self.preemptions = _track(Counter(
+            "smg_engine_preemptions_total",
+            "Requests evicted mid-generation for KV pages", registry=r,
+        ))
+        self.requests_finished = _track(Counter(
+            "smg_engine_requests_finished_total",
+            "Engine request completions by finish reason", ["reason"], registry=r,
+        ))
+        self.spec_drafted = _track(Counter(
+            "smg_engine_spec_draft_tokens_total",
+            "Speculative tokens proposed (n-gram or draft model)", registry=r,
+        ))
+        self.spec_accepted = _track(Counter(
+            "smg_engine_spec_accepted_tokens_total",
+            "Speculative tokens accepted by the verify pass", registry=r,
+        ))
+        self.radix_hit_pages = _track(Counter(
+            "smg_engine_radix_hit_pages_total",
+            "KV pages reused from the radix cache at admission", registry=r,
+        ))
+        self.radix_miss_pages = _track(Counter(
+            "smg_engine_radix_miss_pages_total",
+            "KV pages newly allocated at admission (radix misses)", registry=r,
+        ))
+        self.radix_evicted_pages = _track(Counter(
+            "smg_engine_radix_evicted_pages_total",
+            "KV pages evicted from the radix cache (LRU + flush)", registry=r,
+        ))
+        self.radix_cached_pages = _track(Gauge(
+            "smg_engine_radix_cached_pages",
+            "KV pages currently held by the radix cache", registry=r,
+        ))
+        self.running_requests = _track(Gauge(
+            "smg_engine_running_requests",
+            "Requests resident in decode slots", registry=r,
+        ))
+        self.waiting_requests = _track(Gauge(
+            "smg_engine_waiting_requests",
+            "Requests queued for admission (incl. preempted)", registry=r,
+        ))
+        self.batch_occupancy = _track(Gauge(
+            "smg_engine_batch_occupancy",
+            "Decode-slot occupancy ratio (running / max_batch_size)", registry=r,
+        ))
+        self.kv_free_pages = _track(Gauge(
+            "smg_engine_kv_free_pages", "Free pages in the KV page pool",
+            registry=r,
+        ))
+        self.kv_total_pages = _track(Gauge(
+            "smg_engine_kv_total_pages", "Total pages in the KV page pool",
+            registry=r,
+        ))
+        self.kv_page_utilization = _track(Gauge(
+            "smg_engine_kv_page_utilization",
+            "Fraction of KV pages in use (allocated or cached)", registry=r,
+        ))
+        self.hbm_bytes_in_use = _track(Gauge(
+            "smg_engine_hbm_bytes_in_use",
+            "Device memory in use (device.memory_stats; absent on CPU)",
+            ["device"], registry=r,
+        ))
+        self.hbm_bytes_limit = _track(Gauge(
+            "smg_engine_hbm_bytes_limit",
+            "Device memory capacity (device.memory_stats; absent on CPU)",
+            ["device"], registry=r,
+        ))
+
+    # ---- registry unification ----
+
+    def register_into(self, registry: CollectorRegistry) -> None:
+        """Additionally register every engine collector into ``registry``
+        (the gateway's) so one /metrics scrape covers both layers.
+        All-or-nothing: a name collision (e.g. a second engine adopting into
+        the same gateway registry) rolls back and re-raises, never leaving a
+        half-registered set."""
+        if registry is self.registry:
+            return
+        done = []
+        try:
+            for c in self._collectors:
+                registry.register(c)
+                done.append(c)
+        except ValueError:
+            for c in done:
+                registry.unregister(c)
+            raise
+
+    def unregister_from(self, registry: CollectorRegistry) -> None:
+        for c in self._collectors:
+            try:
+                registry.unregister(c)
+            except KeyError:
+                pass
+
+    # ---- step-loop hooks ----
+
+    def _bump(self, key: str, counter: Counter, cumulative: int) -> None:
+        """Increment ``counter`` by the delta of a scheduler-side cumulative
+        int since the last observation (restart-safe: a smaller value resets
+        the baseline rather than underflowing)."""
+        last = self._last.get(key, 0)
+        if cumulative < last:
+            last = 0
+        if cumulative > last:
+            counter.inc(cumulative - last)
+        self._last[key] = cumulative
+
+    def observe_step(
+        self,
+        *,
+        step_s: float,
+        prefill_s: float,
+        decode_s: float,
+        prefill_tokens: int,
+        decode_tokens: int,
+        running: int,
+        waiting: int,
+        max_batch: int,
+        free_pages: int,
+        total_pages: int,
+        cached_pages: int,
+        cumulative: dict | None = None,
+    ) -> None:
+        """Record one scheduler step.  ``prefill_tokens``/``decode_tokens``
+        are this step's deltas; ``cumulative`` carries the scheduler's
+        monotonically-growing counters (spec/preemption/radix), converted to
+        Prometheus increments here."""
+        self.step_duration.labels(phase="step").observe(step_s)
+        if prefill_tokens:
+            self.step_duration.labels(phase="prefill").observe(prefill_s)
+            self.prefill_tokens.inc(prefill_tokens)
+        if decode_tokens:
+            self.step_duration.labels(phase="decode").observe(decode_s)
+            self.decode_tokens.inc(decode_tokens)
+        self.running_requests.set(running)
+        self.waiting_requests.set(waiting)
+        self.batch_occupancy.set(running / max_batch if max_batch else 0.0)
+        self.kv_free_pages.set(free_pages)
+        self.kv_total_pages.set(total_pages)
+        self.kv_page_utilization.set(
+            (total_pages - free_pages) / total_pages if total_pages else 0.0
+        )
+        self.radix_cached_pages.set(cached_pages)
+        for key, counter in (
+            ("spec_drafted", self.spec_drafted),
+            ("spec_accepted", self.spec_accepted),
+            ("preemptions", self.preemptions),
+            ("radix_hit_pages", self.radix_hit_pages),
+            ("radix_miss_pages", self.radix_miss_pages),
+            ("radix_evicted_pages", self.radix_evicted_pages),
+            ("cached_prompt_tokens", self.cached_prompt_tokens),
+        ):
+            if cumulative and key in cumulative:
+                self._bump(key, counter, int(cumulative[key]))
+        self.window.record(step_s, prefill_tokens, decode_tokens)
+
+    def on_finish(self, reason: str) -> None:
+        self.requests_finished.labels(reason=reason or "unknown").inc()
+
+    # ---- device memory gauges ----
+
+    def maybe_sample_devices(self, devices, now: float | None = None) -> bool:
+        """Rate-limited HBM sampling (the step loop calls this every step;
+        memory_stats is a host round-trip, so cadence-gate it)."""
+        now = time.monotonic() if now is None else now
+        if now < self._next_device_sample:
+            return False
+        self._next_device_sample = now + self.device_sample_interval_secs
+        self.sample_devices(devices)
+        return True
+
+    def sample_devices(self, devices) -> int:
+        """Read ``memory_stats()`` off every addressable device; returns how
+        many devices reported.  CPU backends (no stats) are skipped silently —
+        the gauges simply never appear, rather than exporting zeros."""
+        sampled = 0
+        for d in devices or ():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                continue
+            if not stats or "bytes_limit" not in stats:
+                continue
+            name = f"{getattr(d, 'platform', 'device')}:{getattr(d, 'id', sampled)}"
+            self.hbm_bytes_in_use.labels(device=name).set(
+                stats.get("bytes_in_use", 0)
+            )
+            self.hbm_bytes_limit.labels(device=name).set(stats["bytes_limit"])
+            sampled += 1
+        return sampled

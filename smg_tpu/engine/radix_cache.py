@@ -55,6 +55,11 @@ class RadixCache:
         self.page_size = page_size
         self.root = RadixNode(key=(), page=-1, parent=None, block_hash=0)
         self._size = 0  # pages held by the tree
+        # cumulative eviction count (LRU evict + clear) — the cache is the
+        # single authority on what left the tree; hit/miss accounting lives
+        # in the scheduler (admission-time) because match_prefix re-probes
+        # back-pressured requests every step
+        self.evicted_pages = 0
         self._event_sink = event_sink
         self._clock = itertools.count()
 
@@ -203,6 +208,7 @@ class RadixCache:
                 node = parent
         if removed_hashes:
             self._emit(BlockRemoved(block_hashes=removed_hashes))
+        self.evicted_pages += len(freed)
         return freed
 
     def clear(self) -> list[int]:
@@ -221,4 +227,4 @@ class RadixCache:
     # ---- stats ----
 
     def stats(self) -> dict:
-        return {"cached_pages": self._size}
+        return {"cached_pages": self._size, "evicted_pages": self.evicted_pages}

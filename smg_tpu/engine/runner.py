@@ -249,15 +249,20 @@ class ModelRunner:
                 return sum(x.nbytes for x in leaves) // self.config.parallel.world_size
         return sum(x.nbytes for x in leaves)
 
+    def local_devices(self) -> list:
+        """Devices this engine occupies (mesh devices, the committed single
+        device, or the default device) — the unit HBM gauges sample over."""
+        return list(self.mesh.devices.flat) if self.mesh is not None else (
+            [self._device] if self._device is not None else jax.devices()[:1]
+        )
+
     def _detect_hbm(self) -> int | None:
         """Free HBM on the tightest device this engine will occupy.
 
         Non-addressable devices (other hosts' chips on a multi-host mesh) and
         backends without memory stats are skipped; None only when NO device
         reports stats (auto-size then falls back to configured num_pages)."""
-        devs = list(self.mesh.devices.flat) if self.mesh is not None else (
-            [self._device] if self._device is not None else jax.devices()[:1]
-        )
+        devs = self.local_devices()
         free = None
         for d in devs:
             try:

@@ -40,9 +40,13 @@ class Scheduler:
         runner: ModelRunner,
         config: EngineConfig,
         event_sink: Callable | None = None,
+        metrics: "object | None" = None,
     ):
         self.runner = runner
         self.config = config
+        # EngineMetrics (engine/metrics.py) — optional so bare schedulers in
+        # tests stay dependency-free; every hook is None-guarded
+        self.metrics = metrics
         self.sched = config.scheduler
         self.ps = runner.spec.page_size
         self.mp = runner.max_pages_per_seq
@@ -64,6 +68,15 @@ class Scheduler:
         self.num_spec_drafted = 0
         self.num_spec_accepted = 0
         self.num_preemptions = 0
+        # radix hit-rate accounting, counted once per admission (NOT per
+        # match_prefix probe — back-pressured requests re-probe every step).
+        # cached vs computed prompt tokens is the single source of truth the
+        # gateway's smg_cached_prompt_tokens_total and the cache-aware
+        # policy both key off.
+        self.num_cached_prompt_tokens = 0
+        self.num_computed_prompt_tokens = 0
+        self.num_radix_hit_pages = 0
+        self.num_radix_miss_pages = 0
 
     # ---- public API ----
 
@@ -84,6 +97,7 @@ class Scheduler:
                 pass
             req.status = RequestStatus.ABORTED
             req.finish = FinishInfo(reason="abort")
+            self._count_finish("abort")
             self.requests.pop(rid, None)
             return True
         self._release(req, FinishInfo(reason="abort"), aborted=True)
@@ -102,7 +116,8 @@ class Scheduler:
         for s in self.slots:
             if s is not None:
                 queued += max(s.sampling.max_new_tokens - len(s.output_ids), 0)
-        return {
+        total_prompt = self.num_cached_prompt_tokens + self.num_computed_prompt_tokens
+        out = {
             "num_waiting": len(self.waiting),
             "num_running": running,
             "spec_drafted": self.num_spec_drafted,
@@ -111,7 +126,24 @@ class Scheduler:
             "cached_pages": self.radix.num_cached_pages if self.radix else 0,
             "total_pages": self.runner.spec.num_pages,
             "queued_tokens": queued,
+            # radix hit-rate accounting (admission-time, see __init__ note):
+            # the gateway's cache-aware policy and smg_cached_prompt_tokens
+            # read the same numbers
+            "cached_prompt_tokens": self.num_cached_prompt_tokens,
+            "computed_prompt_tokens": self.num_computed_prompt_tokens,
+            "cache_hit_rate": (
+                self.num_cached_prompt_tokens / total_prompt if total_prompt else 0.0
+            ),
+            "preemptions": self.num_preemptions,
+            "radix_hit_pages": self.num_radix_hit_pages,
+            "radix_miss_pages": self.num_radix_miss_pages,
+            "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
         }
+        if self.metrics is not None:
+            # rolling-window live signal (p50/p95 step time, tokens/s) for
+            # the /scheduler endpoint, dp-aware routing, and benchmarks
+            out["stats"] = self.metrics.window.snapshot()
+        return out
 
     def flush_cache(self) -> bool:
         """Drop the prefix cache (only when idle, like the reference engines)."""
@@ -126,8 +158,40 @@ class Scheduler:
 
     def step(self) -> list[StepOutput]:
         outputs: list[StepOutput] = []
+        if self.metrics is None:
+            self._admit(outputs)
+            self._decode(outputs)
+            return outputs
+        import time as _time
+
+        pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
+        t0 = _time.perf_counter()
         self._admit(outputs)
+        t1 = _time.perf_counter()
         self._decode(outputs)
+        t2 = _time.perf_counter()
+        self.metrics.observe_step(
+            step_s=t2 - t0,
+            prefill_s=t1 - t0,
+            decode_s=t2 - t1,
+            prefill_tokens=self.num_prefill_tokens - pf0,
+            decode_tokens=self.num_decode_tokens - dc0,
+            running=sum(1 for s in self.slots if s is not None),
+            waiting=len(self.waiting),
+            max_batch=self.sched.max_batch_size,
+            free_pages=self.pool.free_count,
+            total_pages=self.runner.spec.num_pages,
+            cached_pages=self.radix.num_cached_pages if self.radix else 0,
+            cumulative={
+                "spec_drafted": self.num_spec_drafted,
+                "spec_accepted": self.num_spec_accepted,
+                "preemptions": self.num_preemptions,
+                "radix_hit_pages": self.num_radix_hit_pages,
+                "radix_miss_pages": self.num_radix_miss_pages,
+                "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
+                "cached_prompt_tokens": self.num_cached_prompt_tokens,
+            },
+        )
         return outputs
 
     # ---- admission / prefill ----
@@ -151,12 +215,14 @@ class Scheduler:
                         reason="error",
                         message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
                     )
+                    self._count_finish("error")
                     outputs.append(StepOutput(req, [], True, req.finish))
                     continue
                 if req.sampling.max_new_tokens == 0:
                     self.waiting.popleft()
                     req.status = RequestStatus.FINISHED
                     req.finish = FinishInfo(reason="length")
+                    self._count_finish("length")
                     outputs.append(StepOutput(req, [], True, req.finish))
                     continue
 
@@ -182,6 +248,13 @@ class Scheduler:
 
                 self.waiting.popleft()
                 admitted_any = True
+                # admission-time hit-rate accounting (once per admission; a
+                # preempted request re-admits and recounts — its re-prefill
+                # really does re-read/re-compute those tokens)
+                self.num_cached_prompt_tokens += matched_tokens
+                self.num_computed_prompt_tokens += len(prompt) - matched_tokens
+                self.num_radix_hit_pages += len(shared_pages)
+                self.num_radix_miss_pages += need
                 if node is not None:
                     self.radix.lock(node)
                 req.radix_node = node
@@ -830,11 +903,16 @@ class Scheduler:
             return
         self._release(req, FinishInfo(reason=reason, matched_stop=matched_stop))
 
+    def _count_finish(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.on_finish(reason)
+
     def _release(
         self, req: EngineRequest, finish: FinishInfo, aborted: bool = False
     ) -> None:
         req.finish = finish
         req.status = RequestStatus.ABORTED if aborted else RequestStatus.FINISHED
+        self._count_finish(finish.reason)
         if req.slot is not None:
             self.page_tables[req.slot][:] = 0
             self.slots[req.slot] = None

@@ -78,6 +78,10 @@ def build_engine_from_args(args):
         model_id=args.model_path or args.model_preset,
         dtype=getattr(args, "dtype", "bfloat16"),
         draft_model=draft_model,
+        metrics_window_secs=getattr(args, "metrics_window_secs", 30.0),
+        device_metrics_interval_secs=getattr(
+            args, "device_metrics_interval_secs", 10.0
+        ),
     )
     params = None
     vision_params = None

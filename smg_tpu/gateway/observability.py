@@ -9,6 +9,7 @@ the hook points match).
 
 from __future__ import annotations
 
+import contextvars
 import time
 from contextlib import contextmanager
 
@@ -25,6 +26,13 @@ from smg_tpu.utils import get_logger
 logger = get_logger("gateway.observability")
 
 LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: ambient HTTP route for metric labels below the handler layer —
+#: ``track_request`` parks the route here so the router can label TTFT
+#: without threading the request path through every dispatch call
+current_route: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "metrics_current_route", default="unknown"
+)
 
 
 class Metrics:
@@ -81,15 +89,29 @@ class Metrics:
 
     @contextmanager
     def track_request(self, route: str):
+        """Track one request; yields a tracker whose ``status`` the caller
+        sets from the actual response (handlers that return 4xx/5xx without
+        raising must not count as 200).  Unset + no exception = "200"."""
         start = time.perf_counter()
         self.in_flight.inc()
-        status = "200"
+        tracker = _RequestTracker()
+        route_token = current_route.set(route)
         try:
-            yield
+            yield tracker
         except Exception:
-            status = "error"
+            tracker.status = "error"
             raise
         finally:
+            current_route.reset(route_token)
             self.in_flight.dec()
-            self.requests_total.labels(route=route, status=status).inc()
+            self.requests_total.labels(route=route, status=str(tracker.status)).inc()
             self.request_duration.labels(route=route).observe(time.perf_counter() - start)
+
+
+class _RequestTracker:
+    """Mutable status cell handed out by ``Metrics.track_request``."""
+
+    __slots__ = ("status",)
+
+    def __init__(self):
+        self.status = "200"

@@ -17,6 +17,8 @@ import uuid
 from dataclasses import dataclass, field
 
 from smg_tpu.engine.detokenize import IncrementalDecoder, StopStringChecker
+from smg_tpu.gateway.observability import current_route
+from smg_tpu.gateway.tracing import end_stage, stage, start_stage
 from smg_tpu.gateway.worker_client import WorkerGenerateRequest, WorkerStreamChunk
 from smg_tpu.gateway.workers import Worker, WorkerRegistry
 from smg_tpu.policies import PolicyRegistry, RequestContext
@@ -92,11 +94,18 @@ class Router:
         policies: PolicyRegistry,
         tokenizers: TokenizerRegistry,
         config: RouterConfig | None = None,
+        metrics=None,
     ):
         self.registry = registry
         self.policies = policies
         self.tokenizers = tokenizers
         self.config = config or RouterConfig()
+        # gateway Metrics (observability.py) — token/TTFT/retry counters are
+        # recorded here, at dispatch, where chunk usage originates from the
+        # scheduler's admission-time accounting (cached_tokens =
+        # radix-matched tokens), so smg_cached_prompt_tokens_total and the
+        # engine's smg_engine_cached_prompt_tokens_total count one truth
+        self.metrics = metrics
         from smg_tpu.policies.dp import MinimumTokensPolicy, PassthroughDpPolicy
 
         self.dp_policy = (
@@ -301,12 +310,42 @@ class Router:
         exclude: set[str] = set(mm_exclude)
         # dp-rank cost estimate: prompt + generation budget (released on exit)
         dp_cost = len(input_ids) + (worker_sampling.max_new_tokens or 0)
+        # TTFT is attributed from dispatch start: worker selection + engine
+        # queue + prefill, across retries (tokenize happened upstream)
+        t_dispatch = time.perf_counter()
         while True:
             worker = self.select_worker(ctx, exclude=exclude)
             guard = worker.acquire()
             got_first_chunk = False
             finished_cleanly = False
             dp_rank = self.dp_policy.select_dp_rank(worker, dp_cost)
+            # engine-stage child spans under the request's SERVER span
+            # (gateway/tracing.py): prefill = dispatch -> first chunk,
+            # decode = first chunk -> finish; None (zero-cost) without a
+            # configured tracer
+            prefill_span = start_stage(
+                "engine.prefill", worker_id=worker.worker_id, rid=rid,
+                prompt_tokens=len(input_ids),
+            )
+            decode_span = None
+            detok_busy_ns = 0
+            last_output_tokens = 0
+
+            def _close_spans(error: bool) -> None:
+                nonlocal prefill_span, decode_span
+                end_stage(prefill_span, error=error)
+                end_stage(decode_span, error=error,
+                          output_tokens=last_output_tokens)
+                if not error and decode_span is not None and detok_busy_ns:
+                    # synthetic busy-width span: detokenize work is smeared
+                    # across chunks, so report its cumulative cost as one
+                    # trailing stage span
+                    dspan = start_stage("engine.detokenize", rid=rid)
+                    if dspan is not None:
+                        dspan.start_ns = time.time_ns() - detok_busy_ns
+                        end_stage(dspan, busy_ns=detok_busy_ns)
+                prefill_span = decode_span = None
+
             try:
                 wreq = WorkerGenerateRequest(
                     rid=rid, input_ids=input_ids, sampling=worker_sampling,
@@ -314,8 +353,31 @@ class Router:
                     mm_embeds=mm,
                 )
                 async for chunk in worker.client.generate(wreq):
+                    if not got_first_chunk and prefill_span is not None:
+                        end_stage(prefill_span, cached_tokens=chunk.cached_tokens)
+                        prefill_span = None
+                        decode_span = start_stage(
+                            "engine.decode", worker_id=worker.worker_id, rid=rid,
+                        )
+                    if not got_first_chunk and self.metrics is not None:
+                        self.metrics.ttft.labels(route=current_route.get()).observe(
+                            time.perf_counter() - t_dispatch
+                        )
+                        self.metrics.prompt_tokens.inc(chunk.prompt_tokens)
+                        if chunk.cached_tokens:
+                            self.metrics.cached_tokens.inc(chunk.cached_tokens)
+                    if self.metrics is not None and chunk.output_tokens > last_output_tokens:
+                        self.metrics.generated_tokens.inc(
+                            chunk.output_tokens - last_output_tokens
+                        )
                     got_first_chunk = True
-                    ev = self._chunk_to_event(chunk, detok, stop_checker)
+                    last_output_tokens = chunk.output_tokens
+                    if decode_span is not None:
+                        _dt0 = time.perf_counter_ns()
+                        ev = self._chunk_to_event(chunk, detok, stop_checker)
+                        detok_busy_ns += time.perf_counter_ns() - _dt0
+                    else:
+                        ev = self._chunk_to_event(chunk, detok, stop_checker)
                     if ev is not None:
                         yield ev
                         if ev.finished and not chunk.finished:
@@ -349,6 +411,8 @@ class Router:
                 if got_first_chunk or attempts >= self.config.max_retries:
                     logger.exception("request %s failed on %s", rid, worker.worker_id)
                     raise RouteError(502, f"worker error: {e}", "worker_error")
+                if self.metrics is not None:
+                    self.metrics.retries_total.inc()
                 backoff = min(
                     self.config.retry_backoff_base * (2 ** (attempts - 1)),
                     self.config.retry_backoff_max,
@@ -357,8 +421,13 @@ class Router:
                     "retrying %s after failure on %s (attempt %d): %s",
                     rid, worker.worker_id, attempts, e,
                 )
+                # close the failed attempt's spans BEFORE the backoff sleep
+                # so their duration is the real attempt, not attempt + idle
+                # (idempotent: the finally-side call then no-ops)
+                _close_spans(error=True)
                 await asyncio.sleep(backoff)
             finally:
+                _close_spans(error=not finished_cleanly)
                 if dp_rank is not None:
                     self.dp_policy.release(worker, dp_rank, dp_cost)
                 if not finished_cleanly:
@@ -372,6 +441,7 @@ class Router:
         prompt KV; decode leg imports it and streams tokens (reference:
         dual-dispatch in request_execution.rs:34-82; KV rides the connector
         seam — host-mediated here, ICI/DCN on multi-chip deployments)."""
+        t_dispatch = time.perf_counter()  # TTFT attribution, as in _execute
         policy = self.policies.policy_for(ctx.model_id)
         p_worker = policy.select_worker(prefill_pool, ctx)
         if p_worker is None:
@@ -401,13 +471,19 @@ class Router:
                     pass
 
         p_guard = p_worker.acquire()
+        p_span = start_stage(
+            "engine.prefill", worker_id=p_worker.worker_id, rid=rid,
+            prompt_tokens=len(input_ids), pd_leg="prefill",
+        )
         try:
             export = await p_worker.client.prefill_export(
                 input_ids, worker_sampling, connector=connector
             )
             p_guard.release(success=True)
+            end_stage(p_span)
         except Exception as e:
             p_guard.release(success=False)
+            end_stage(p_span, error=True)
             raise RouteError(502, f"prefill worker error: {e}", "worker_error")
 
         # transfer mode: the prefill worker's offered KV stays pinned until
@@ -455,12 +531,31 @@ class Router:
             raise
         d_guard = d_worker.acquire()
         finished_cleanly = False
+        got_first_chunk = False
+        last_output_tokens = 0
+        d_span = start_stage(
+            "engine.decode", worker_id=d_worker.worker_id, rid=rid,
+            pd_leg="decode",
+        )
         try:
             wreq = WorkerGenerateRequest(rid=rid, input_ids=input_ids, sampling=worker_sampling)
             async for chunk in d_worker.client.generate_prefilled(
                 wreq, export["first_token"], export["k"], export["v"]
             ):
                 await _signal(consumed=True)  # decode leg is live: KV pulled
+                if not got_first_chunk and self.metrics is not None:
+                    self.metrics.ttft.labels(route=current_route.get()).observe(
+                        time.perf_counter() - t_dispatch
+                    )
+                    self.metrics.prompt_tokens.inc(chunk.prompt_tokens)
+                    if chunk.cached_tokens:
+                        self.metrics.cached_tokens.inc(chunk.cached_tokens)
+                got_first_chunk = True
+                if self.metrics is not None and chunk.output_tokens > last_output_tokens:
+                    self.metrics.generated_tokens.inc(
+                        chunk.output_tokens - last_output_tokens
+                    )
+                last_output_tokens = chunk.output_tokens
                 ev = self._chunk_to_event(chunk, detok, stop_checker)
                 if ev is not None:
                     yield ev
@@ -488,6 +583,8 @@ class Router:
             d_guard.release(success=False)
             raise RouteError(502, f"decode worker error: {e}", "worker_error")
         finally:
+            end_stage(d_span, error=not finished_cleanly,
+                      output_tokens=last_output_tokens)
             # no chunk ever arrived: the offer was never pulled — reclaim
             await _signal(consumed=False)
             if not finished_cleanly:
@@ -579,6 +676,12 @@ class Router:
         the tokenspeed encoder servicer): parse image content parts ->
         decode -> per-model resize/normalize/patchify -> worker Encode RPC ->
         grid-expand the placeholder token -> splice positions."""
+        # one tokenize stage span for BOTH legs — the multimodal branch is
+        # where gateway-side tokenize/encode cost is largest
+        with stage("engine.tokenize"):
+            return await self._prepare_chat_any(req)
+
+    async def _prepare_chat_any(self, req: ChatCompletionRequest):
         import numpy as np
 
         from smg_tpu.multimodal.ingest import (
@@ -1124,6 +1227,10 @@ class Router:
     # ---- completions ----
 
     def _prepare_completion(self, req: CompletionRequest):
+        with stage("engine.tokenize"):
+            return self._prepare_completion_inner(req)
+
+    def _prepare_completion_inner(self, req: CompletionRequest):
         tokenizer = self.tokenizers.get(req.model or None)
         sampling = req.to_sampling_params(self.config.default_max_tokens)
         prompts: list[tuple[str | None, list[int]]] = []

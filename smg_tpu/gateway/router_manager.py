@@ -33,11 +33,14 @@ _CONFIG_FIELDS = frozenset(
 class RouterManager:
     """Per-model :class:`Router` instances over shared registries."""
 
-    def __init__(self, registry, policies, tokenizers, default_config=None):
+    def __init__(self, registry, policies, tokenizers, default_config=None,
+                 metrics=None):
         self.registry = registry
         self.policies = policies
         self.tokenizers = tokenizers
-        self.default = Router(registry, policies, tokenizers, default_config)
+        self.metrics = metrics
+        self.default = Router(registry, policies, tokenizers, default_config,
+                              metrics=metrics)
         self._per_model: dict[str, Router] = {}
 
     def router_for(self, model_id: str | None) -> Router:
@@ -76,7 +79,8 @@ class RouterManager:
                 )
             cfg = dataclasses.replace(self.default.config, **config)
             new_router = Router(
-                self.registry, self.policies, self.tokenizers, cfg
+                self.registry, self.policies, self.tokenizers, cfg,
+                metrics=self.metrics,
             )
         if policy is not None:
             from smg_tpu.policies.base import get_policy

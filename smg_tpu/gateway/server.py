@@ -78,12 +78,17 @@ class AppContext:
         # multi-model (IGW) coordination: per-model routers over shared
         # registries; ``self.router`` stays the default instance so
         # single-model deployments and existing call sites are unchanged
+        self.metrics = Metrics()
         self.routers = RouterManager(
-            self.registry, self.policies, self.tokenizers, router_config
+            self.registry, self.policies, self.tokenizers, router_config,
+            metrics=self.metrics,
         )
         self.router = self.routers.default
         self.semaphore = asyncio.Semaphore(max_concurrent_requests)
-        self.metrics = Metrics()
+        # unify engine metrics into the gateway registry as in-proc workers
+        # register (launch `serve`, tests, runtime /workers adds alike)
+        self._adopted_engine_metrics: set[int] = set()
+        self.registry.on_change(self._maybe_adopt_worker_metrics)
         self.auth = Authenticator(auth_config or AuthConfig())
         # request identity / tenancy / limits plumbing (CLI flag groups)
         self.request_id_headers = list(request_id_headers or [])
@@ -138,6 +143,40 @@ class AppContext:
             from smg_tpu.gateway.tracing import OtelTracer
 
             self.tracer = OtelTracer(otel_endpoint, otel_service_name)
+
+    def adopt_engine_metrics(self, engine_metrics) -> bool:
+        """Register an in-proc engine's metric set (engine/metrics.py) into
+        the gateway registry so /metrics exports one coherent smg_* set —
+        gateway request counters and engine step-loop series side by side.
+        Idempotent; a second engine's identically-named collectors are
+        skipped with a warning (its series stay on the engine's own
+        registry) rather than corrupting the scrape."""
+        if id(engine_metrics) in self._adopted_engine_metrics:
+            return True
+        try:
+            engine_metrics.register_into(self.metrics.registry)
+        except ValueError:
+            logger.warning(
+                "engine metrics collide with series already in the gateway "
+                "registry; keeping them on the engine-local registry"
+            )
+            return False
+        self._adopted_engine_metrics.add(id(engine_metrics))
+        return True
+
+    def _maybe_adopt_worker_metrics(self, event: str, worker) -> None:
+        """Registry hook: an in-proc worker carries its engine's metric set —
+        fold it into /metrics the moment the worker joins, and drop it again
+        when the worker leaves (stale collectors would freeze on the scrape
+        AND collide with a replacement engine's registration)."""
+        em = getattr(worker.client, "engine_metrics", None)
+        if em is None:
+            return
+        if event == "added":
+            self.adopt_engine_metrics(em)
+        elif event == "removed" and id(em) in self._adopted_engine_metrics:
+            em.unregister_from(self.metrics.registry)
+            self._adopted_engine_metrics.discard(id(em))
 
     def ensure_jobs(self):
         if self.jobs is None:
@@ -243,6 +282,13 @@ async def otel_middleware(request: web.Request, handler):
     span.set("url.path", request.path)
     span.set("request.id", request.get("request_id", ""))
     request["otel_span"] = span
+    # park span + tracer in contextvars so pipeline stages (queue, tokenize,
+    # prefill, decode, detokenize) anywhere down-stack open children of this
+    # request's span (gateway/tracing.py stage helpers)
+    from smg_tpu.gateway.tracing import current_span, current_tracer
+
+    span_token = current_span.set(span)
+    tracer_token = current_tracer.set(tracer)
     try:
         resp = await handler(request)
         span.set("http.response.status_code", resp.status)
@@ -254,6 +300,8 @@ async def otel_middleware(request: web.Request, handler):
         span.end(error=True)
         raise
     finally:
+        current_span.reset(span_token)
+        current_tracer.reset(tracer_token)
         tracer.record(span)
 
 
@@ -381,18 +429,28 @@ async def admission_middleware(request: web.Request, handler):
     priority = ctx.priority.classify(request.headers)
     import time as _time
 
+    from smg_tpu.gateway.tracing import end_stage, start_stage
+
     q_start = _time.perf_counter()
+    q_span = start_stage("engine.queue", priority=priority)
     try:
         guard = await ctx.priority.admit(priority)
     except AdmissionRejected as e:
+        end_stage(q_span, error=True)
         ctx.rate_limiter.release(tenant)
         return _error(503, str(e), "overloaded_error")
+    end_stage(q_span)
     ctx.metrics.queue_wait.labels(priority=priority).observe(_time.perf_counter() - q_start)
     try:
-        with ctx.metrics.track_request(request.path):
+        with ctx.metrics.track_request(request.path) as track:
             if priority not in ctx.priority.config.preemptable:
-                return await handler(request)
-            return await _run_preemptable(ctx, request, handler, guard, priority)
+                resp = await handler(request)
+            else:
+                resp = await _run_preemptable(ctx, request, handler, guard, priority)
+            # count the REAL status: handlers returning 4xx/5xx responses
+            # without raising must not be recorded as status="200"
+            track.status = str(getattr(resp, "status", 200))
+            return resp
     finally:
         guard.release()
         ctx.rate_limiter.release(tenant)
@@ -561,8 +619,22 @@ async def h_metrics(request: web.Request) -> web.Response:
 
 
 async def h_scheduler_stats(request: web.Request) -> web.Response:
+    """Priority-scheduler state plus per-worker engine step-loop stats
+    (rolling p50/p95 step time, tokens/s, cache hit rate from loads())."""
     ctx: AppContext = request.app["ctx"]
-    return web.json_response(ctx.priority.describe())
+    body = ctx.priority.describe()
+
+    async def _loads(w):
+        # per-worker timeout (like health.py's probes): one black-holed
+        # remote worker must not wedge the whole endpoint
+        try:
+            return w.worker_id, await asyncio.wait_for(w.client.get_loads(), 2.0)
+        except Exception as e:
+            return w.worker_id, {"error": str(e)}
+
+    results = await asyncio.gather(*(_loads(w) for w in ctx.registry.list()))
+    body["engine"] = dict(results)
+    return web.json_response(body)
 
 
 async def h_health(request: web.Request) -> web.Response:

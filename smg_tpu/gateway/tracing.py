@@ -13,8 +13,10 @@ Enabled by ``--otel-endpoint`` (off by default — zero overhead when off).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import os
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from smg_tpu.utils import get_logger
@@ -75,17 +77,32 @@ class Span:
         }
 
 
+_HEX_DIGITS = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str) -> bool:
+    return bool(s) and all(c in _HEX_DIGITS for c in s)
+
+
 def parse_traceparent(header: str | None) -> tuple[str, str] | None:
     """W3C traceparent -> (trace_id, parent_span_id), or None if absent or
-    malformed (a malformed header starts a fresh trace, per spec)."""
+    malformed (a malformed header starts a fresh trace, per spec).
+
+    Field lengths alone are not enough: ``00-zz..-..-01`` would propagate a
+    garbage trace id into every exported span, so every field must be actual
+    (case-normalized) hex and the ids non-zero."""
     if not header:
         return None
-    parts = header.strip().split("-")
-    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4 or len(parts[0]) != 2 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    if len(parts[3]) != 2 or not all(_is_hex(p) for p in parts):
+        return None
+    if parts[0] == "ff":  # forbidden version value, per spec
         return None
     if parts[1] == "0" * 32 or parts[2] == "0" * 16:
         return None
-    return parts[1].lower(), parts[2].lower()
+    return parts[1], parts[2]
 
 
 class OtelTracer:
@@ -192,3 +209,59 @@ class OtelTracer:
             # keep buffering — export must never wedge request handling
             logger.warning("otel export failed: %s", e)
             self.dropped += len(batch)
+
+
+# ---- engine-stage child spans (queue → tokenize → prefill → decode →
+# detokenize).  The otel middleware parks the request's SERVER span and the
+# tracer in contextvars; pipeline stages anywhere down-stack (admission,
+# router dispatch, detokenize) open INTERNAL children of it without threading
+# tracer references through every constructor.  Contextvars propagate through
+# the request's task tree, so stages land under the right trace even with
+# many requests in flight. ----
+
+SPAN_KIND_INTERNAL = 1
+
+current_span: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "otel_current_span", default=None
+)
+current_tracer: contextvars.ContextVar["OtelTracer | None"] = contextvars.ContextVar(
+    "otel_current_tracer", default=None
+)
+
+
+def start_stage(name: str, **attrs) -> Span | None:
+    """Open a child span of the ambient request span; None when tracing is
+    off (zero overhead — no tracer, no span objects)."""
+    tracer = current_tracer.get()
+    parent = current_span.get()
+    if tracer is None or parent is None:
+        return None
+    span = tracer.start_span(name, parent=parent, kind=SPAN_KIND_INTERNAL)
+    for k, v in attrs.items():
+        span.set(k, v)
+    return span
+
+
+def end_stage(span: Span | None, error: bool = False, **attrs) -> None:
+    """Finish + record a stage span (no-op for None)."""
+    if span is None:
+        return
+    for k, v in attrs.items():
+        span.set(k, v)
+    span.end(error=error)
+    tracer = current_tracer.get()
+    if tracer is not None:
+        tracer.record(span)
+
+
+@contextmanager
+def stage(name: str, **attrs):
+    """``with stage("engine.tokenize"): ...`` — ambient child span around a
+    pipeline stage; exceptions mark the span errored and re-raise."""
+    span = start_stage(name, **attrs)
+    try:
+        yield span
+    except BaseException:
+        end_stage(span, error=True)
+        raise
+    end_stage(span)

@@ -123,6 +123,14 @@ class WorkerClient:
         """Register a KV-event batch callback; returns unsubscribe fn."""
         return lambda: None
 
+    @property
+    def engine_metrics(self):
+        """EngineMetrics of a colocated engine, or None.  In-proc clients
+        expose it so the gateway folds engine series into its /metrics
+        registry; remote transports return None (a remote worker's engine
+        metrics are scraped from that process, not proxied)."""
+        return None
+
     async def close(self) -> None:
         pass
 
@@ -229,7 +237,16 @@ class InProcWorkerClient(WorkerClient):
         return True
 
     async def get_loads(self) -> dict:
-        return self.engine.loads()
+        # includes engine-deep stats: cached/computed prompt tokens,
+        # cache_hit_rate, and the rolling step-stats window under "stats".
+        # loads() takes the engine RLock — off the event loop, or a
+        # multi-second chunked-prefill step would stall every request
+        return await asyncio.to_thread(self.engine.loads)
+
+    @property
+    def engine_metrics(self):
+        # getattr-chained: engine-less stubs (health-test doubles) stay valid
+        return getattr(getattr(self, "engine", None), "metrics", None)
 
     async def get_model_info(self) -> dict:
         cfg = self.engine.config

@@ -1,0 +1,580 @@
+"""Engine-deep observability (ISSUE 1): unified gateway+engine metric
+registry, step-loop telemetry, engine-stage span parenting, and /metrics
+exposition of engine series driven through a real (CPU-backed) engine.
+
+Layout mirrors the layer split: unit tests for ``gateway/observability.py``
+and ``engine/metrics.py``, tracing-stage tests for ``gateway/tracing.py``,
+the docs-drift gate (``scripts/check_metric_docs.py``), then an e2e section
+that drives requests through the full aiohttp app + in-proc engine (same
+harness as test_gateway.py) and scrapes ``/metrics``."""
+
+import asyncio
+import importlib.util
+import re
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from prometheus_client import CollectorRegistry
+
+from smg_tpu.engine.metrics import EngineMetrics, RollingStepStats
+from smg_tpu.gateway.observability import Metrics, current_route
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def metric_value(text: str, name: str, labels: dict | None = None) -> float | None:
+    """Value of the first exposition sample matching ``name`` and (a superset
+    of) ``labels``; None when no sample matches."""
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = re.match(r"([a-zA-Z_:][\w:]*)(?:\{(.*)\})?\s+(\S+)", line)
+        if not m or m.group(1) != name:
+            continue
+        got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(2) or ""))
+        if labels and any(got.get(k) != v for k, v in labels.items()):
+            continue
+        return float(m.group(3))
+    return None
+
+
+# ---- gateway Metrics: track_request status labels (satellite: 4xx/5xx
+# responses returned without raising must not count as 200) ----
+
+
+def test_track_request_defaults_to_200():
+    m = Metrics()
+    with m.track_request("/v1/chat/completions"):
+        pass
+    body = m.export().decode()
+    assert metric_value(body, "smg_requests_total",
+                        {"route": "/v1/chat/completions", "status": "200"}) == 1.0
+
+
+def test_track_request_records_actual_status():
+    m = Metrics()
+    with m.track_request("/r") as track:
+        track.status = "429"
+    with m.track_request("/r") as track:
+        track.status = 503  # ints are stringified
+    body = m.export().decode()
+    assert metric_value(body, "smg_requests_total", {"route": "/r", "status": "429"}) == 1.0
+    assert metric_value(body, "smg_requests_total", {"route": "/r", "status": "503"}) == 1.0
+    assert metric_value(body, "smg_requests_total", {"route": "/r", "status": "200"}) is None
+
+
+def test_track_request_exception_counts_as_error():
+    m = Metrics()
+    with pytest.raises(RuntimeError):
+        with m.track_request("/r"):
+            raise RuntimeError("boom")
+    body = m.export().decode()
+    assert metric_value(body, "smg_requests_total", {"route": "/r", "status": "error"}) == 1.0
+    assert metric_value(body, "smg_in_flight_requests") == 0.0
+
+
+def test_track_request_sets_ambient_route():
+    m = Metrics()
+    assert current_route.get() == "unknown"
+    with m.track_request("/v1/completions"):
+        assert current_route.get() == "/v1/completions"
+    assert current_route.get() == "unknown"
+
+
+# ---- EngineMetrics: registration + unification ----
+
+
+def test_engine_metrics_register_into_gateway_registry():
+    gw = Metrics()
+    em = EngineMetrics()
+    em.register_into(gw.registry)
+    body = gw.export().decode()
+    # both layers in one scrape
+    assert "smg_requests_total" in body
+    assert "smg_engine_step_duration_seconds" in body
+    assert "smg_engine_kv_page_utilization" in body
+    # collectors stay live on the engine's own registry too
+    em.kv_total_pages.set(64)
+    assert metric_value(gw.export().decode(), "smg_engine_kv_total_pages") == 64.0
+
+
+def test_engine_metrics_collision_rolls_back():
+    gw = Metrics()
+    em1, em2 = EngineMetrics(), EngineMetrics()
+    em1.register_into(gw.registry)
+    with pytest.raises(ValueError):
+        em2.register_into(gw.registry)  # identical names collide
+    # all-or-nothing: nothing from em2 leaked into the gateway registry,
+    # and the original set still exports exactly once
+    body = gw.export().decode()
+    assert body.count("# TYPE smg_engine_kv_total_pages ") == 1
+    # em2 remains fully usable on its own registry after the rollback
+    em2.register_into(CollectorRegistry())
+
+
+def test_engine_metrics_unregister_from():
+    gw = Metrics()
+    em = EngineMetrics()
+    em.register_into(gw.registry)
+    em.unregister_from(gw.registry)
+    assert "smg_engine_" not in gw.export().decode()
+    em.register_into(gw.registry)  # re-registrable after removal
+
+
+def test_register_into_own_registry_is_noop():
+    em = EngineMetrics()
+    em.register_into(em.registry)  # must not raise on double-register
+
+
+def test_worker_removal_releases_engine_metrics():
+    """A removed in-proc worker's collectors leave the gateway registry, so
+    a replacement engine's metric set can register without colliding."""
+    from smg_tpu.gateway.server import AppContext
+
+    class _Client:
+        def __init__(self, em):
+            self.engine_metrics = em
+
+    class _Worker:
+        def __init__(self, em, wid):
+            self.client = _Client(em)
+            self.worker_id = wid
+
+    ctx = AppContext()
+    em1, em2 = EngineMetrics(), EngineMetrics()
+    ctx._maybe_adopt_worker_metrics("added", _Worker(em1, "w0"))
+    assert "smg_engine_step_duration_seconds" in ctx.metrics.export().decode()
+    ctx._maybe_adopt_worker_metrics("removed", _Worker(em1, "w0"))
+    assert "smg_engine_" not in ctx.metrics.export().decode()
+    # replacement engine registers cleanly
+    assert ctx.adopt_engine_metrics(em2) is True
+    assert "smg_engine_step_duration_seconds" in ctx.metrics.export().decode()
+
+
+# ---- RollingStepStats ----
+
+
+def test_rolling_stats_percentiles_and_rates():
+    w = RollingStepStats(window_secs=10.0)
+    for i in range(100):
+        w.record(step_seconds=(i + 1) / 1000.0, prefill_tokens=10,
+                 decode_tokens=5, now=100.0 + i * 0.01)
+    snap = w.snapshot(now=101.0)
+    assert snap["num_steps"] == 100
+    assert snap["p50_step_seconds"] == pytest.approx(0.051, abs=0.002)
+    assert snap["p95_step_seconds"] == pytest.approx(0.095, abs=0.002)
+    assert snap["prefill_tokens_per_s"] > 0
+    assert snap["tokens_per_s"] == pytest.approx(
+        snap["prefill_tokens_per_s"] + snap["decode_tokens_per_s"])
+
+
+def test_rolling_stats_window_prunes():
+    w = RollingStepStats(window_secs=5.0)
+    w.record(0.01, 1, 1, now=0.0)
+    w.record(0.01, 1, 1, now=1.0)
+    assert w.snapshot(now=1.0)["num_steps"] == 2
+    snap = w.snapshot(now=100.0)  # both aged out
+    assert snap["num_steps"] == 0
+    assert snap["tokens_per_s"] == 0.0
+
+
+def test_rolling_stats_bounded_samples():
+    w = RollingStepStats(window_secs=1e9, max_samples=16)
+    for i in range(100):
+        w.record(0.01, 1, 1, now=float(i) * 1e-6)
+    assert w.snapshot(now=1.0)["num_steps"] <= 16
+
+
+# ---- EngineMetrics.observe_step: cumulative-counter delta tracking ----
+
+
+def _observe(em, *, prefill_tokens=0, decode_tokens=0, running=0, cumulative=None):
+    em.observe_step(
+        step_s=0.01, prefill_s=0.005, decode_s=0.005,
+        prefill_tokens=prefill_tokens, decode_tokens=decode_tokens,
+        running=running, waiting=0, max_batch=8,
+        free_pages=100, total_pages=128, cached_pages=4,
+        cumulative=cumulative,
+    )
+
+
+def test_observe_step_converts_cumulatives_to_increments():
+    em = EngineMetrics()
+    _observe(em, prefill_tokens=32, decode_tokens=4, running=2,
+             cumulative={"spec_drafted": 10, "spec_accepted": 6,
+                         "radix_hit_pages": 3, "cached_prompt_tokens": 48})
+    _observe(em, decode_tokens=4, running=2,
+             cumulative={"spec_drafted": 15, "spec_accepted": 9,
+                         "radix_hit_pages": 3, "cached_prompt_tokens": 48})
+    from prometheus_client import generate_latest
+
+    body = generate_latest(em.registry).decode()
+    assert metric_value(body, "smg_engine_spec_draft_tokens_total") == 15.0
+    assert metric_value(body, "smg_engine_spec_accepted_tokens_total") == 9.0
+    assert metric_value(body, "smg_engine_radix_hit_pages_total") == 3.0
+    assert metric_value(body, "smg_engine_cached_prompt_tokens_total") == 48.0
+    assert metric_value(body, "smg_engine_prefill_tokens_total") == 32.0
+    assert metric_value(body, "smg_engine_decode_tokens_total") == 8.0
+    assert metric_value(body, "smg_engine_step_duration_seconds_count",
+                        {"phase": "step"}) == 2.0
+    # prefill phase only observed on steps that actually prefilled
+    assert metric_value(body, "smg_engine_step_duration_seconds_count",
+                        {"phase": "prefill"}) == 1.0
+    assert metric_value(body, "smg_engine_batch_occupancy") == 0.25
+    assert metric_value(body, "smg_engine_kv_page_utilization") == pytest.approx(28 / 128)
+    assert em.window.snapshot()["num_steps"] == 2
+
+
+def test_observe_step_cumulative_reset_is_safe():
+    em = EngineMetrics()
+    from prometheus_client import generate_latest
+
+    _observe(em, cumulative={"preemptions": 5})
+    _observe(em, cumulative={"preemptions": 2})  # restart: smaller than last
+    body = generate_latest(em.registry).decode()
+    # no underflow; new baseline counts from the reset value
+    assert metric_value(body, "smg_engine_preemptions_total") == 7.0
+
+
+def test_on_finish_reason_labels():
+    from prometheus_client import generate_latest
+
+    em = EngineMetrics()
+    em.on_finish("stop")
+    em.on_finish("length")
+    em.on_finish("")
+    body = generate_latest(em.registry).decode()
+    assert metric_value(body, "smg_engine_requests_finished_total", {"reason": "stop"}) == 1.0
+    assert metric_value(body, "smg_engine_requests_finished_total", {"reason": "unknown"}) == 1.0
+
+
+# ---- device memory gauges ----
+
+
+class _FakeDev:
+    platform, id = "tpu", 0
+
+    def memory_stats(self):
+        return {"bytes_in_use": 123, "bytes_limit": 1024}
+
+
+class _NoStatsDev:
+    platform, id = "cpu", 0
+
+    def memory_stats(self):
+        raise NotImplementedError
+
+
+def test_sample_devices_reads_stats_and_guards_cpu():
+    from prometheus_client import generate_latest
+
+    em = EngineMetrics()
+    assert em.sample_devices([_NoStatsDev()]) == 0
+    assert em.sample_devices([_FakeDev(), _NoStatsDev()]) == 1
+    body = generate_latest(em.registry).decode()
+    assert metric_value(body, "smg_engine_hbm_bytes_in_use", {"device": "tpu:0"}) == 123.0
+    assert metric_value(body, "smg_engine_hbm_bytes_limit", {"device": "tpu:0"}) == 1024.0
+
+
+def test_sample_devices_skips_real_cpu_devices():
+    import jax
+
+    em = EngineMetrics()
+    em.sample_devices(jax.devices("cpu"))  # must not raise; gauges stay empty or 0
+    # whatever CPU reports, the call is guarded — no exception is the contract
+
+
+def test_maybe_sample_devices_cadence():
+    em = EngineMetrics(device_sample_interval_secs=10.0)
+    assert em.maybe_sample_devices([_FakeDev()], now=100.0) is True
+    assert em.maybe_sample_devices([_FakeDev()], now=105.0) is False
+    assert em.maybe_sample_devices([_FakeDev()], now=110.1) is True
+
+
+# ---- engine-stage spans (gateway/tracing.py) ----
+
+
+def test_stage_spans_parent_under_ambient_request_span():
+    from smg_tpu.gateway.tracing import (
+        SPAN_KIND_INTERNAL,
+        OtelTracer,
+        current_span,
+        current_tracer,
+        end_stage,
+        stage,
+        start_stage,
+    )
+
+    tracer = OtelTracer("http://collector.invalid:4318")
+    parent = tracer.start_span("POST /v1/chat/completions")
+    t_tok = current_tracer.set(tracer)
+    s_tok = current_span.set(parent)
+    try:
+        span = start_stage("engine.prefill", worker_id="w0")
+        assert span is not None
+        assert span.trace_id == parent.trace_id
+        assert span.parent_span_id == parent.span_id
+        assert span.kind == SPAN_KIND_INTERNAL
+        assert span.attributes["worker_id"] == "w0"
+        end_stage(span, cached_tokens=16)
+        assert span.end_ns >= span.start_ns
+        assert span in tracer._buffer  # recorded for export
+        with pytest.raises(ValueError):
+            with stage("engine.decode"):
+                raise ValueError("boom")
+        errored = tracer._buffer[-1]
+        assert errored.name == "engine.decode"
+        assert errored.status_code == 2  # error
+    finally:
+        current_span.reset(s_tok)
+        current_tracer.reset(t_tok)
+
+
+def test_stage_spans_are_none_without_ambient_tracer():
+    from smg_tpu.gateway.tracing import end_stage, stage, start_stage
+
+    assert start_stage("engine.prefill") is None
+    end_stage(None)  # no-op
+    with stage("engine.decode") as span:
+        assert span is None
+
+
+def test_parse_traceparent_validates_hex():
+    from smg_tpu.gateway.tracing import parse_traceparent
+
+    good = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    assert parse_traceparent(good) == ("ab" * 16, "cd" * 8)
+    # uppercase is case-normalized, per W3C
+    assert parse_traceparent(good.upper()) == ("ab" * 16, "cd" * 8)
+    # correct lengths, garbage content — must NOT propagate
+    assert parse_traceparent("00-" + "zz" * 16 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("00-" + "ab" * 16 + "-" + "zz" * 8 + "-01") is None
+    assert parse_traceparent("0x-" + "ab" * 16 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-0g") is None
+    # forbidden version
+    assert parse_traceparent("ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01") is None
+
+
+# ---- docs drift gate (scripts/check_metric_docs.py) ----
+
+
+def _load_drift_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_docs", REPO_ROOT / "scripts" / "check_metric_docs.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_docs_in_sync():
+    mod = _load_drift_checker()
+    assert mod.check() == []
+
+
+def test_drift_checker_catches_undocumented_series():
+    mod = _load_drift_checker()
+    counts = mod.exported_families()
+    docs = mod.documented_families()
+    counts["smg_bogus_series_total"] = 1
+    errors = [
+        e for e in (
+            f"family {n} is exported but missing from the docs table"
+            for n in counts if n not in docs
+        )
+    ]
+    assert any("smg_bogus_series_total" in e for e in errors)
+
+
+# ---- e2e: full gateway + in-proc engine, one /metrics scrape ----
+
+
+def make_engine():
+    from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+    from smg_tpu.engine.engine import Engine
+    from smg_tpu.models.config import tiny_test_config
+
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=256, auto_size=False, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=8, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4, 8),
+            speculative=True, spec_max_draft=6,  # exercise spec-decode series
+        ),
+        dtype="float32",
+        model_id="tiny-test",
+    )
+    return Engine(cfg)
+
+
+@pytest.fixture(scope="module")
+def gateway():
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.worker_client import InProcWorkerClient
+    from smg_tpu.gateway.workers import Worker
+    from smg_tpu.tokenizer import MockTokenizer
+
+    loop = asyncio.new_event_loop()
+    ctx = AppContext(policy="round_robin")
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+    engine = make_engine()
+
+    async def _setup():
+        client = InProcWorkerClient(engine)
+        ctx.registry.add(Worker(worker_id="w0", client=client, model_id="tiny-test"))
+        server = TestServer(build_app(ctx))
+        tc = TestClient(server)
+        await tc.start_server()
+        return tc
+
+    import threading
+
+    loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
+    loop_thread.start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=120)
+
+    tc = run(_setup())
+
+    class Handle:
+        pass
+
+    h = Handle()
+    h.run = run
+    h.client = tc
+    h.ctx = ctx
+    h.engine = engine
+    yield h
+    run(tc.close())
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+# repetitive 24-token prompt: crosses a 16-token page (radix-cacheable) and
+# gives prompt-lookup speculation n-gram matches to draft from
+REPETITIVE_PROMPT = "w5 w6 w7 w8 " * 6
+
+
+def _completion(gateway, prompt=REPETITIVE_PROMPT, max_tokens=24):
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/completions",
+            json={"model": "tiny-test", "prompt": prompt.strip(),
+                  "max_tokens": max_tokens, "temperature": 0, "ignore_eos": True},
+        )
+        return resp.status, await resp.json()
+
+    return gateway.run(go())
+
+
+def _scrape(gateway) -> str:
+    async def go():
+        resp = await gateway.client.get("/metrics")
+        assert resp.status == 200
+        return await resp.text()
+
+    return gateway.run(go())
+
+
+def test_metrics_exports_engine_series_from_one_registry(gateway):
+    status, body = _completion(gateway)
+    assert status == 200, body
+    status, _ = _completion(gateway)  # identical → radix prefix hit
+    assert status == 200
+
+    text = _scrape(gateway)
+
+    # single registry: gateway series and engine series in one scrape
+    assert metric_value(text, "smg_requests_total",
+                        {"route": "/v1/completions", "status": "200"}) >= 2.0
+    assert metric_value(text, "smg_time_to_first_token_seconds_count",
+                        {"route": "/v1/completions"}) >= 2.0
+    assert metric_value(text, "smg_prompt_tokens_total") >= 48.0
+    assert metric_value(text, "smg_generated_tokens_total") >= 48.0
+
+    # step latency histogram, split by phase
+    assert metric_value(text, "smg_engine_step_duration_seconds_count",
+                        {"phase": "step"}) > 0
+    assert metric_value(text, "smg_engine_step_duration_seconds_count",
+                        {"phase": "prefill"}) > 0
+    assert metric_value(text, "smg_engine_step_duration_seconds_count",
+                        {"phase": "decode"}) > 0
+    # token throughput: each request's first token comes out of the prefill
+    # step, so decode counts max_tokens - 1 per request
+    assert metric_value(text, "smg_engine_prefill_tokens_total") > 0
+    assert metric_value(text, "smg_engine_decode_tokens_total") >= 46.0
+    # page pool
+    assert metric_value(text, "smg_engine_kv_total_pages") == 256.0
+    assert metric_value(text, "smg_engine_kv_free_pages") > 0
+    assert metric_value(text, "smg_engine_kv_page_utilization") is not None
+    assert metric_value(text, "smg_engine_batch_occupancy") is not None
+    # radix cache: first request misses, second hits the shared prefix
+    assert metric_value(text, "smg_engine_radix_miss_pages_total") > 0
+    assert metric_value(text, "smg_engine_radix_hit_pages_total") > 0
+    assert metric_value(text, "smg_engine_cached_prompt_tokens_total") > 0
+    assert metric_value(text, "smg_engine_radix_cached_pages") > 0
+    # speculative decoding on a repetitive context drafts (and accepts)
+    assert metric_value(text, "smg_engine_spec_draft_tokens_total") > 0
+    assert metric_value(text, "smg_engine_spec_accepted_tokens_total") is not None
+    # finish accounting
+    assert metric_value(text, "smg_engine_requests_finished_total",
+                        {"reason": "length"}) >= 2.0
+
+
+def test_gateway_and_engine_agree_on_cached_tokens(gateway):
+    """Satellite: smg_cached_prompt_tokens_total (gateway) and
+    smg_engine_cached_prompt_tokens_total (engine) count one source of truth
+    — the scheduler's admission-time radix accounting."""
+    _completion(gateway)
+    _completion(gateway)
+    text = _scrape(gateway)
+    gw = metric_value(text, "smg_cached_prompt_tokens_total")
+    en = metric_value(text, "smg_engine_cached_prompt_tokens_total")
+    assert gw is not None and en is not None and gw > 0
+    assert gw == en
+    loads = gateway.engine.loads()
+    assert loads["cached_prompt_tokens"] == gw
+
+
+def test_scheduler_endpoint_exposes_engine_stats(gateway):
+    _completion(gateway)
+
+    async def go():
+        resp = await gateway.client.get("/scheduler")
+        assert resp.status == 200
+        return await resp.json()
+
+    body = gateway.run(go())
+    assert "engine" in body
+    w0 = body["engine"]["w0"]
+    for key in ("cached_prompt_tokens", "computed_prompt_tokens",
+                "cache_hit_rate", "radix_hit_pages", "radix_miss_pages",
+                "radix_evicted_pages", "preemptions"):
+        assert key in w0, key
+    stats = w0["stats"]
+    assert stats["num_steps"] > 0
+    assert stats["tokens_per_s"] > 0
+    assert stats["p95_step_seconds"] >= stats["p50_step_seconds"] >= 0
+
+
+def test_http_4xx_response_recorded_with_real_status(gateway):
+    """Satellite: an inference handler returning 400 without raising must
+    count as status="400", not "200" (track_request only wraps
+    INFERENCE_ROUTES, and h_chat returns _error(400) on a bad body)."""
+
+    async def go():
+        resp = await gateway.client.post(
+            "/v1/chat/completions", data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        return resp.status
+
+    assert gateway.run(go()) == 400
+    text = _scrape(gateway)
+    assert metric_value(text, "smg_requests_total",
+                        {"route": "/v1/chat/completions", "status": "400"}) == 1.0
+    assert metric_value(text, "smg_requests_total",
+                        {"route": "/v1/chat/completions", "status": "200"}) is None
