@@ -231,6 +231,13 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                         "n-gram proposals)")
     g.add_argument("--draft-model-preset", default=None, dest="draft_model_preset",
                    help="named preset for the draft model")
+    g.add_argument("--overlap-schedule", default="on", choices=["on", "off"],
+                   dest="overlap_schedule",
+                   help="one-step-lookahead decode pipeline: the next device "
+                        "step launches before last step's outputs are "
+                        "consumed (host work hides behind TPU compute). "
+                        "Token streams are byte-identical either way; 'off' "
+                        "is the fully synchronous fallback")
     g.add_argument("--max-batch-size", type=int, default=64)
     g.add_argument("--max-seq-len", type=int, default=8192)
     g.add_argument("--page-size", type=int, default=16)

@@ -152,6 +152,23 @@ class _Parser:
                  "n": _Pred({"\n"}), "t": _Pred({"\t"}), "r": _Pred({"\r"})}
         return table.get(c, _Pred({c}))
 
+    def _class_atom(self) -> "str | _Pred":
+        """One [...] member: a literal character (possibly from an escape
+        like ``\\t`` or ``\\-``, returned as str so it can serve as a range
+        endpoint) or a class-escape predicate (``\\d``/``\\S``/...)."""
+        c = self.peek()
+        if c != "\\":
+            self.i += 1
+            return c
+        self.i += 1
+        if self.i >= len(self.p):
+            raise ValueError("dangling escape in char class")
+        e = self.p[self.i]
+        if e in "dDwWsS":
+            return self._escape(e)  # advances past the escape char
+        self.i += 1
+        return {"n": "\n", "t": "\t", "r": "\r"}.get(e, e)
+
     def _char_class(self):
         self.i += 1  # [
         negate = False
@@ -164,24 +181,34 @@ class _Parser:
         first = True
         while self.peek() is not None and (self.peek() != "]" or first):
             first = False
-            c = self.peek()
-            if c == "\\":
-                self.i += 1
-                if self.i >= len(self.p):
-                    raise ValueError("dangling escape in char class")
-                sub = self._escape(self.p[self.i])
-                if sub.chars is not None and not sub.negate:
-                    chars |= sub.chars
+            lo = self._class_atom()
+            if not isinstance(lo, str):
+                # multi-char class escape: a set member, never a range
+                # endpoint (matches re semantics for [\d-x]: literal '-')
+                if lo.chars is not None and not lo.negate:
+                    chars |= lo.chars
                 else:
-                    extra_members.append(sub)
+                    extra_members.append(lo)
                 continue
-            self.i += 1
-            if self.peek() == "-" and self.i + 1 < len(self.p) and self.p[self.i + 1] != "]":
-                hi = self.p[self.i + 1]
-                self.i += 2
-                chars |= {chr(x) for x in range(ord(c), ord(hi) + 1)}
+            # a '-' not followed by ']' starts a range; the low endpoint may
+            # itself come from an escape ([\t-z] is the range \t..z, not the
+            # set {'\t','-','z'}), and so may the high one ([!-\\])
+            if (
+                self.peek() == "-"
+                and self.i + 1 < len(self.p)
+                and self.p[self.i + 1] != "]"
+            ):
+                self.i += 1  # consume '-'
+                hi = self._class_atom()
+                if not isinstance(hi, str):
+                    raise ValueError(
+                        "char-class range endpoint cannot be a class escape"
+                    )
+                if ord(hi) < ord(lo):
+                    raise ValueError(f"bad character range {lo!r}-{hi!r}")
+                chars |= {chr(x) for x in range(ord(lo), ord(hi) + 1)}
             else:
-                chars.add(c)
+                chars.add(lo)
         if self.peek() != "]":
             raise ValueError("unbalanced char class")
         self.i += 1

@@ -85,6 +85,13 @@ class SchedulerConfig:
     # single-chunk prompts admitted together in one batched prefill call
     # (fills the MXU and amortizes dispatch; long prompts still chunk solo)
     max_prefill_group: int = 8
+    # overlapped decode pipeline (one-step lookahead): the step loop launches
+    # the next decode before last step's outputs are consumed, so host-side
+    # work (detokenize, stop strings, admission bookkeeping) hides behind
+    # device compute.  Token streams stay byte-identical to the synchronous
+    # path; speculative decoding and grammar-masked batches force a sync
+    # boundary (their next device step depends on last step's host results).
+    overlap_schedule: bool = True
     # speculative decoding (prompt-lookup drafting, engine/speculative.py):
     # greedy requests verify up to spec_max_draft n-gram-proposed tokens in
     # one forward.  Token-identical to plain greedy decode.

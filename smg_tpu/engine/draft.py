@@ -15,6 +15,11 @@ for rejected proposals lands past the committed ``seq_len`` and is simply
 overwritten by the next ``ensure_context`` — the same overshoot convention
 the target cache already relies on.  Draft state never affects correctness
 (the target verify gates every token); it only affects acceptance rate.
+
+Overlap interaction: an installed draft runner forces the scheduler's
+overlapped pipeline into its synchronous fallback (same as n-gram
+speculation) — ``ensure_context``/``propose`` need last step's committed
+tokens host-side before the next device call can be shaped.
 """
 
 from __future__ import annotations

@@ -534,7 +534,7 @@ class Engine:
             prompt_tokens=req.prompt_len,
             output_tokens=len(req.output_ids),
             cached_tokens=req.cached_tokens,
-            logprobs=req.logprobs[-len(so.new_token_ids):] if so.new_token_ids else [],
+            logprobs=list(so.logprobs),
         )
         if req.detok is None:
             return out
@@ -601,6 +601,13 @@ class Engine:
             self._thread = None
 
     def _loop(self) -> None:
+        """Drives the step loop — and, with ``overlap_schedule`` on, the
+        two-stage decode pipeline: each ``step()`` consumes the previously
+        launched device work and leaves the next launch in flight, so host
+        postprocessing here (detokenize, stop strings, callbacks) overlaps
+        device compute.  ``has_work`` includes the in-flight frame, so the
+        pipeline drains naturally after the last request finishes or aborts;
+        an explicit stop() discards whatever is still in flight."""
         logger.info("engine loop started")
         while True:
             with self._wakeup:
@@ -614,6 +621,11 @@ class Engine:
             except Exception:
                 logger.exception("engine step failed")
                 time.sleep(0.1)
+        with self._lock:
+            # stop() mid-generation: the frame's results will never be
+            # consumed (clients are gone); drop it so the sampling-key
+            # counter and penalty state stay coherent for a restart
+            self.scheduler.drop_inflight()
         logger.info("engine loop stopped")
 
     # ---- sync convenience ----

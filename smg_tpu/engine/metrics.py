@@ -214,6 +214,32 @@ class EngineMetrics:
             "Device memory capacity (device.memory_stats; absent on CPU)",
             ["device"], registry=r,
         ))
+        # overlapped decode pipeline (scheduler one-step lookahead)
+        self.lookahead_launches = _track(Counter(
+            "smg_engine_lookahead_launches_total",
+            "Overlap-pipeline steps by lookahead outcome (kept = chained "
+            "launch stood; discarded = schedule changed, launch dropped; "
+            "sync = no lookahead launched, forced-sync or unpredictable)",
+            ["outcome"], registry=r,
+        ))
+        self.deferred_fetch = _track(Histogram(
+            "smg_engine_deferred_fetch_seconds",
+            "Time blocked materializing an in-flight decode's results "
+            "(device not yet done when the host came back for them)",
+            buckets=STEP_LATENCY_BUCKETS, registry=r,
+        ))
+        self.overlap_host_busy = _track(Counter(
+            "smg_engine_overlap_host_busy_seconds_total",
+            "Host-side step time excluding the deferred fetch wait "
+            "(scheduling, detokenize, bookkeeping that overlap device work)",
+            registry=r,
+        ))
+        self.overlap_device_wait = _track(Counter(
+            "smg_engine_overlap_device_wait_seconds_total",
+            "Cumulative deferred-fetch wait (host stalled on the device); "
+            "rate vs overlap_host_busy gives the pipeline balance",
+            registry=r,
+        ))
 
     # ---- registry unification ----
 
@@ -306,6 +332,17 @@ class EngineMetrics:
 
     def on_finish(self, reason: str) -> None:
         self.requests_finished.labels(reason=reason or "unknown").inc()
+
+    def observe_overlap(
+        self, *, outcome: str, fetch_wait_s: float, host_s: float
+    ) -> None:
+        """Record one overlap-pipeline step: its lookahead outcome and the
+        host-busy vs device-wait split (the numbers that show whether host
+        work actually hides behind device compute)."""
+        self.lookahead_launches.labels(outcome=outcome).inc()
+        self.deferred_fetch.observe(fetch_wait_s)
+        self.overlap_host_busy.inc(max(host_s, 0.0))
+        self.overlap_device_wait.inc(max(fetch_wait_s, 0.0))
 
     # ---- device memory gauges ----
 

@@ -71,6 +71,10 @@ class EngineRequest:
     # draft-model proposer: committed tokens mirrored into the draft KV
     # cache so far (engine/draft.py; reset on preemption)
     draft_len: int = 0
+    # scheduler admission serial: unique per request lifetime, used to key
+    # decode-state reuse in the overlap pipeline (rids are client-supplied
+    # and reusable; object ids recycle after GC)
+    sched_serial: int = -1
 
     @property
     def prompt_len(self) -> int:
@@ -97,3 +101,7 @@ class StepOutput:
     new_token_ids: list[int]
     finished: bool
     finish: FinishInfo | None = None
+    # per-token logprobs captured at ACCEPT time — slicing request.logprobs
+    # later mis-attributes them once a step carries both a prefill and a
+    # decode increment for the same request
+    logprobs: list[float] = field(default_factory=list)

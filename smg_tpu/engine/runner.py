@@ -59,6 +59,36 @@ def _pick_sampler():
     return _sample_exact if os.environ.get("SMG_EXACT_SAMPLING") == "1" else _sample_fast
 
 
+class DecodeState:
+    """Device-resident steady-state decode inputs.
+
+    The overlapped pipeline re-dispatches decode for an unchanged batch
+    composition every step; without this object the scheduler pays ~10
+    ``jnp.asarray`` host->device uploads per step for arrays that only change
+    on admit/finish/preempt (sampling params, LoRA indices, penalty scalars)
+    or on page growth (page tables).  The scheduler keys reuse off
+    ``lane_sig`` (lane composition + bucket + feature flags) and ``pt_sig``
+    plus its ``_pages_dirty`` flag (page tables); the next step's input
+    TOKENS chain device-side from the in-flight frame's last sampled column
+    (``InFlightFrame.toks[:, -1]``), so a steady-state lookahead launch
+    uploads nothing but a [B] positions vector."""
+
+    __slots__ = (
+        "lane_sig", "temps", "topks", "topps", "minps",
+        "slot_idx", "freqs", "pres", "reps", "lora_idx", "rope_delta",
+        "pt_sig", "page_tables",
+    )
+
+    def __init__(self):
+        self.lane_sig = None
+        self.temps = self.topks = self.topps = self.minps = None
+        self.slot_idx = self.freqs = self.pres = self.reps = None
+        self.lora_idx = None
+        self.rope_delta = None
+        self.pt_sig = None
+        self.page_tables = None
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -200,6 +230,30 @@ class ModelRunner:
         else:
             for k in [k for k in self._compiled if k[0] == kind]:
                 del self._compiled[k]
+
+    def _kv_donation_blocks_dispatch(self) -> bool:
+        """True when donating the KV buffers would make jit dispatch
+        synchronous (the CPU PJRT client waits for execution before
+        returning when an input is donated), defeating the overlapped
+        pipeline's async launch.  TPU/GPU clients dispatch donated calls
+        asynchronously, and there donation is non-negotiable (the cache is
+        most of HBM).  Scoped to configurations where the overlapped
+        schedule is actually ACTIVE (mirrors Scheduler.step's condition:
+        overlap on, no speculative/draft decoding forcing the sync
+        fallback): a synchronous CPU run gains nothing from async dispatch,
+        so it keeps donation (and the in-place cache update) rather than
+        paying a full cache copy per decode call."""
+        sched = self.config.scheduler
+        if (
+            not sched.overlap_schedule
+            or sched.speculative
+            or self.config.draft_model is not None
+        ):
+            return False
+        try:
+            return self.local_devices()[0].platform == "cpu"
+        except Exception:
+            return False
 
     def _attn_impl_for(self, B: int, mp: int) -> str:
         """Per-shape kernel choice.  Short contexts: XLA's fused
@@ -375,6 +429,16 @@ class ModelRunner:
     def _next_key(self):
         self._step += 1
         return jax.random.fold_in(self._rng_key, self._step)
+
+    def rng_mark(self) -> int:
+        """Snapshot the sampling-key counter before a speculative (lookahead)
+        dispatch; ``rng_restore`` rewinds it if the dispatch is discarded so
+        the replacement call folds the SAME key the synchronous path would
+        have used — the invariant behind overlap/sync stream parity."""
+        return self._step
+
+    def rng_restore(self, mark: int) -> None:
+        self._step = mark
 
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
                     use_mask: bool = False, use_lora: bool = False,
@@ -711,7 +775,16 @@ class ModelRunner:
 
         n_extra = ((6 if use_pen else 0) + (1 if use_mask else 0)
                    + (2 if use_lora else 0) + (1 if use_mrope else 0))
+        # KV donation aliases the cache update in place — essential on TPU
+        # (cache is a large fraction of HBM).  The CPU backend, however,
+        # BLOCKS the dispatching thread for the whole execution when any
+        # input is donated (measured: donated jit call returns after compute;
+        # undonated returns in ~0.1ms), which would serialize the overlapped
+        # decode pipeline on the host thread.  CPU memory is not the scarce
+        # resource, so skip donation there and keep async dispatch.
         donate = (4, 5) + ((12,) if use_pen else ())
+        if self._kv_donation_blocks_dispatch():
+            donate = ()
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r,
@@ -727,22 +800,28 @@ class ModelRunner:
         self._compiled[k] = fn
         return fn
 
-    def decode_multi(
+    def decode_multi_async(
         self,
-        tokens: np.ndarray,
-        positions: np.ndarray,
-        page_tables: np.ndarray,  # [B, mp]
-        temps: np.ndarray,
-        topks: np.ndarray,
-        topps: np.ndarray,
-        minps: np.ndarray,
+        tokens,  # [B] int32 (np OR device array — device chaining is free)
+        positions,  # [B] int32
+        page_tables,  # [B, mp] int32
+        temps,
+        topks,
+        topps,
+        minps,
         num_steps: int,
         pen: tuple | None = None,  # (slot_idx [B], freqs [B], pres [B], reps [B])
         mask: np.ndarray | None = None,  # [B, V] bool
-        lora_idx: np.ndarray | None = None,  # [B] adapter slot per row (0 = none)
-        rope_delta: np.ndarray | None = None,  # [B] M-RoPE decode offsets
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
+        lora_idx=None,  # [B] adapter slot per row (0 = none)
+        rope_delta=None,  # [B] M-RoPE decode offsets
+    ) -> tuple[jax.Array, jax.Array]:
+        """Dispatch a decode horizon and return UNMATERIALIZED result arrays
+        (tokens [B, num_steps], logprobs [B, num_steps]).  JAX async dispatch
+        means this returns as soon as the computation is enqueued — the
+        overlapped scheduler consumes last step's tokens while this one runs.
+        Every input accepts either numpy (uploaded once) or a resident
+        ``jax.Array`` (``jnp.asarray`` is a no-op), which is how the
+        ``DecodeState`` buffers avoid per-step uploads."""
         B, mp = page_tables.shape
         use_pen = pen is not None
         use_mask = mask is not None
@@ -786,6 +865,30 @@ class ModelRunner:
             toks, lps, self.k_cache, self.v_cache, self._counts_buf = out
         else:
             toks, lps, self.k_cache, self.v_cache = out
+        return toks, lps
+
+    def decode_multi(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        page_tables: np.ndarray,  # [B, mp]
+        temps: np.ndarray,
+        topks: np.ndarray,
+        topps: np.ndarray,
+        minps: np.ndarray,
+        num_steps: int,
+        pen: tuple | None = None,
+        mask: np.ndarray | None = None,
+        lora_idx: np.ndarray | None = None,
+        rope_delta: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Synchronous decode horizon: dispatch + blocking fetch.
+        Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
+        toks, lps = self.decode_multi_async(
+            tokens, positions, page_tables, temps, topks, topps, minps,
+            num_steps, pen=pen, mask=mask, lora_idx=lora_idx,
+            rope_delta=rope_delta,
+        )
         return np.asarray(toks), np.asarray(lps)
 
     def _decode_fn(self, B: int, mp: int):

@@ -9,12 +9,31 @@ into ``ModelRunner``; all bookkeeping (pages, slots, stops) lives host-side.
 Step shape: admit waiting requests (prefill, chunked under
 ``max_prefill_tokens``), then one decode step for every running slot.
 Prefill-priority keeps TTFT low; decode keeps slots saturated.
+
+Overlapped pipeline (``SchedulerConfig.overlap_schedule``, default on): the
+decode launch of step N is dispatched BEFORE step N-1's outputs are
+consumed, exploiting JAX async dispatch — ``decode_multi_async`` returns
+unmaterialized arrays, and the host runs detokenization / stop scanning /
+admission bookkeeping while the device computes the next step (SGLang's
+overlap scheduler / vLLM async scheduling, TPU-shaped).  An
+``InFlightFrame`` records the launch; a speculative lookahead launch chains
+the frame's own device-resident last-token column as the next input.  Any
+divergence from the schedule the synchronous path would have run (finish,
+stop-string rollback, abort, pending admission) discards the frame and
+rewinds the sampling-key counter, which keeps token streams byte-identical
+to ``overlap_schedule off``.  Speculative decoding and grammar-masked
+batches force a sync boundary (their next device call depends on last
+step's host results).  ``DecodeState`` keeps steady-state decode inputs
+(sampling params, penalty scalars, LoRA indices, page tables)
+device-resident, refreshed only on batch-composition or page-table change.
 """
 
 from __future__ import annotations
 
 import math
+import time
 from collections import deque
+from dataclasses import dataclass
 from typing import Callable
 
 import numpy as np
@@ -28,10 +47,42 @@ from smg_tpu.engine.request import (
     RequestStatus,
     StepOutput,
 )
-from smg_tpu.engine.runner import ModelRunner
+from smg_tpu.engine.runner import DecodeState, ModelRunner
 from smg_tpu.utils import get_logger
 
 logger = get_logger("engine.scheduler")
+
+
+@dataclass
+class InFlightFrame:
+    """One dispatched decode horizon whose results are not yet consumed.
+
+    ``lanes`` pins each batch row to (slot, request, expected_seq_len): the
+    request's ``seq_len`` must still equal the recorded value when the frame
+    is consumed, else the lane went stale while in flight (stop-string
+    rollback, abort, external finish) and its tokens are dropped — their KV
+    landed past the request's final ``seq_len``, which never enters the
+    radix cache (the same overshoot convention the decode horizon uses).
+
+    ``toks``/``lps`` are unmaterialized ``jax.Array``s: JAX async dispatch
+    returns them before the device finishes, and ``np.asarray`` at consume
+    time is the deferred fetch.  ``rng_mark`` is set on lookahead frames so
+    a discarded launch can rewind the sampling-key counter."""
+
+    lanes: list  # [(slot, EngineRequest, expected_seq_len)]
+    toks: "object"  # jax.Array [B, horizon]
+    lps: "object"  # jax.Array [B, horizon]
+    horizon: int
+    B: int  # padded batch bucket
+    B_real: int
+    mp_b: int
+    positions: "object" = None  # np [B] launch positions (lookahead chaining)
+    lane_sig: tuple = ()  # DecodeState signature the launch was built under
+    use_pen: bool = False
+    use_lora: bool = False
+    use_mrope: bool = False
+    rng_mark: int | None = None
+    lookahead: bool = False
 
 
 class Scheduler:
@@ -77,12 +128,23 @@ class Scheduler:
         self.num_computed_prompt_tokens = 0
         self.num_radix_hit_pages = 0
         self.num_radix_miss_pages = 0
+        # overlapped decode pipeline (engine/engine.py drives step_overlap):
+        # the frame whose device work is in flight, the persistent
+        # device-resident decode inputs, and lookahead outcome counters
+        self.inflight: InFlightFrame | None = None
+        self._dstate = DecodeState()
+        self._pages_dirty = True  # page-table rows changed since last upload
+        self._serial = 0  # admission serial for decode-state signatures
+        self.num_lookahead_kept = 0
+        self.num_lookahead_discarded = 0
 
     # ---- public API ----
 
     def add_request(self, req: EngineRequest) -> None:
         if req.rid in self.requests:
             raise ValueError(f"duplicate request id {req.rid}")
+        self._serial += 1
+        req.sched_serial = self._serial
         self.requests[req.rid] = req
         self.waiting.append(req)
 
@@ -104,7 +166,11 @@ class Scheduler:
         return True
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(s is not None for s in self.slots)
+        return (
+            bool(self.waiting)
+            or any(s is not None for s in self.slots)
+            or self.inflight is not None
+        )
 
     def loads(self) -> dict:
         running = sum(1 for s in self.slots if s is not None)
@@ -138,6 +204,10 @@ class Scheduler:
             "radix_hit_pages": self.num_radix_hit_pages,
             "radix_miss_pages": self.num_radix_miss_pages,
             "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
+            # overlap pipeline: lookahead launches that stood vs. were
+            # discarded after a schedule change (stop/abort/admission)
+            "lookahead_kept": self.num_lookahead_kept,
+            "lookahead_discarded": self.num_lookahead_discarded,
         }
         if self.metrics is not None:
             # rolling-window live signal (p50/p95 step time, tokens/s) for
@@ -149,6 +219,9 @@ class Scheduler:
         """Drop the prefix cache (only when idle, like the reference engines)."""
         if any(s is not None for s in self.slots) or self.waiting:
             return False
+        # an idle scheduler can still hold a stale in-flight frame (all its
+        # lanes finished since launch); resolve it before swapping buffers
+        self.drop_inflight()
         if self.radix:
             self.pool.free(self.radix.clear())
         self.runner.flush_cache_buffers()
@@ -158,41 +231,255 @@ class Scheduler:
 
     def step(self) -> list[StepOutput]:
         outputs: list[StepOutput] = []
-        if self.metrics is None:
-            self._admit(outputs)
-            self._decode(outputs)
-            return outputs
-        import time as _time
-
+        m = self.metrics
         pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
-        t0 = _time.perf_counter()
-        self._admit(outputs)
-        t1 = _time.perf_counter()
-        self._decode(outputs)
-        t2 = _time.perf_counter()
-        self.metrics.observe_step(
-            step_s=t2 - t0,
-            prefill_s=t1 - t0,
-            decode_s=t2 - t1,
-            prefill_tokens=self.num_prefill_tokens - pf0,
-            decode_tokens=self.num_decode_tokens - dc0,
-            running=sum(1 for s in self.slots if s is not None),
-            waiting=len(self.waiting),
-            max_batch=self.sched.max_batch_size,
-            free_pages=self.pool.free_count,
-            total_pages=self.runner.spec.num_pages,
-            cached_pages=self.radix.num_cached_pages if self.radix else 0,
-            cumulative={
-                "spec_drafted": self.num_spec_drafted,
-                "spec_accepted": self.num_spec_accepted,
-                "preemptions": self.num_preemptions,
-                "radix_hit_pages": self.num_radix_hit_pages,
-                "radix_miss_pages": self.num_radix_miss_pages,
-                "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
-                "cached_prompt_tokens": self.num_cached_prompt_tokens,
-            },
+        t0 = time.perf_counter() if m else 0.0
+        # the speculative paths (n-gram + draft model) force a sync boundary:
+        # their NEXT device call (propose/verify shapes, acceptance) depends
+        # on last step's host-side results, so there is nothing to overlap
+        overlap = (
+            self.sched.overlap_schedule
+            and not self.sched.speculative
+            and self.draft is None
         )
+        if overlap:
+            admit_s, fetch_s, outcome = self._step_overlap(outputs)
+        else:
+            self.drop_inflight()  # mode flip mid-run: never strand a frame
+            self._admit(outputs)
+            admit_s = (time.perf_counter() - t0) if m else 0.0
+            self._decode(outputs)
+            fetch_s, outcome = 0.0, None
+        if m is not None:
+            t2 = time.perf_counter()
+            step_s = t2 - t0
+            m.observe_step(
+                step_s=step_s,
+                prefill_s=admit_s,
+                decode_s=step_s - admit_s,
+                prefill_tokens=self.num_prefill_tokens - pf0,
+                decode_tokens=self.num_decode_tokens - dc0,
+                running=sum(1 for s in self.slots if s is not None),
+                waiting=len(self.waiting),
+                max_batch=self.sched.max_batch_size,
+                free_pages=self.pool.free_count,
+                total_pages=self.runner.spec.num_pages,
+                cached_pages=self.radix.num_cached_pages if self.radix else 0,
+                cumulative={
+                    "spec_drafted": self.num_spec_drafted,
+                    "spec_accepted": self.num_spec_accepted,
+                    "preemptions": self.num_preemptions,
+                    "radix_hit_pages": self.num_radix_hit_pages,
+                    "radix_miss_pages": self.num_radix_miss_pages,
+                    "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
+                    "cached_prompt_tokens": self.num_cached_prompt_tokens,
+                },
+            )
+            if outcome is not None:
+                m.observe_overlap(
+                    outcome=outcome,
+                    fetch_wait_s=fetch_s,
+                    host_s=max(step_s - fetch_s, 0.0),
+                )
         return outputs
+
+    # ---- overlapped pipeline (one-step lookahead) ----
+    #
+    # Invariant: token streams are byte-identical to the synchronous path.
+    # The sequence of device calls (prefill/decode, with their folded
+    # sampling keys and batch compositions) must therefore be EXACTLY the
+    # sequence the sync scheduler would have issued; a lookahead launch that
+    # turns out to mismatch it (a finish, a rollback, a pending admission)
+    # is discarded and the sampling-key counter rewound before relaunching.
+
+    def _step_overlap(self, outputs: list[StepOutput]) -> tuple[float, float, str]:
+        """One pipeline iteration; returns (admit_s, fetch_wait_s, outcome)."""
+        frame = self.inflight
+        self.inflight = None
+        fetch_s = 0.0
+        outcome = "sync"
+        if frame is not None and self._frame_stale(frame):
+            # the schedule changed while the frame was in flight (stop-string
+            # rollback, abort, external finish, PD adoption, or a submission
+            # behind a kept lookahead): its tokens never existed in the sync
+            # schedule.  Their KV overshoot past each request's final seq_len
+            # never enters the radix cache, so dropping them is safe.
+            self._discard_frame(frame)
+            outcome = "discarded"
+            frame = None
+        if frame is not None:
+            # launch the NEXT decode chained on the in-flight one BEFORE
+            # fetching its results — the whole point: the deferred fetch +
+            # host bookkeeping below overlap the device computing the
+            # lookahead step
+            look = self._launch_lookahead(frame)
+            fetch_s = self._consume_frame(frame, outputs)
+            if look is not None:
+                if self._frame_stale(look):
+                    # consuming finished/trimmed a lane: the sync schedule
+                    # would repack the batch (and refold the key) — discard
+                    self._discard_frame(look)
+                    outcome = "discarded"
+                else:
+                    self.inflight = look
+                    outcome = "kept"
+        admit_s = 0.0
+        if self.inflight is None:
+            ta = time.perf_counter()
+            self._admit(outputs)
+            admit_s = time.perf_counter() - ta
+            active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+            if active:
+                self.inflight = self._launch_frame(active)
+        return admit_s, fetch_s, outcome
+
+    def _mp_bucket(self, pages_needed: int) -> int:
+        """Power-of-two page-table width bucket (>= 8, capped at the full
+        table) — bounds the jit variant count while trimming decode
+        attention to live pages.  Every launch path (sync, lookahead, spec
+        verify) must share this so their compiled shapes and the
+        overlap/sync page tables agree."""
+        mp_b = 8
+        while mp_b < pages_needed:
+            mp_b *= 2
+        return min(mp_b, self.mp)
+
+    def _frame_stale(self, frame: InFlightFrame) -> bool:
+        """True when the frame no longer matches the schedule the sync path
+        would run: any lane released/rolled back, the active set changed, or
+        (lookahead only) a submission is waiting — sync admits BEFORE
+        decoding, so the lookahead's key fold is out of order."""
+        if frame.lookahead and self.waiting:
+            return True
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if len(active) != len(frame.lanes):
+            return True
+        for (slot, req, expected), (i, r) in zip(frame.lanes, active):
+            if (
+                slot != i
+                or req is not r
+                or req.is_finished
+                or req.seq_len != expected
+            ):
+                return True
+        return False
+
+    def _discard_frame(self, frame: InFlightFrame) -> None:
+        """Drop an in-flight frame's results.  Rewinds the sampling-key
+        counter (so the replacement launch folds the key the sync schedule
+        would have) unless something else folded a key since the launch
+        (e.g. a PD prefill_only interleave — parity is already off there).
+        Device-side penalty counts advanced by the discarded horizon are
+        marked for host-side re-derivation."""
+        if frame.lookahead:
+            # loads()' kept/discarded pair describes LOOKAHEAD launches only
+            # (a stale cold frame dropped on stop/abort is not a lookahead
+            # outcome and would inflate the ratio)
+            self.num_lookahead_discarded += 1
+        if (
+            frame.rng_mark is not None
+            and self.runner._step == frame.rng_mark + 1
+        ):
+            self.runner.rng_restore(frame.rng_mark)
+        if frame.use_pen:
+            for _slot, req, _expected in frame.lanes:
+                if req.sampling.has_penalties and not req.is_finished:
+                    req.penalty_synced = False
+
+    def drop_inflight(self) -> None:
+        """Discard any pending frame (engine stop/drain, cache flush, or a
+        runtime overlap-mode flip)."""
+        if self.inflight is not None:
+            self._discard_frame(self.inflight)
+            self.inflight = None
+
+    def _consume_frame(
+        self, frame: InFlightFrame, outputs: list[StepOutput]
+    ) -> float:
+        """Deferred fetch + host-side acceptance; returns seconds blocked on
+        the device (np.asarray materializes the async results)."""
+        t0 = time.perf_counter()
+        toks = np.asarray(frame.toks)
+        lps = np.asarray(frame.lps)
+        fetch_s = time.perf_counter() - t0
+        if frame.lookahead:
+            self.num_lookahead_kept += 1
+        self.num_decode_tokens += frame.B_real * frame.horizon
+        for idx, (_slot, req, _expected) in enumerate(frame.lanes):
+            self._accept_tokens(
+                req,
+                [int(t) for t in toks[idx]],
+                [float(x) for x in lps[idx]],
+                outputs,
+                advance_seq=True,
+            )
+        return fetch_s
+
+    def _launch_lookahead(self, frame: InFlightFrame) -> InFlightFrame | None:
+        """Chained launch for the step AFTER ``frame``, dispatched before
+        ``frame`` is consumed.  Input tokens are the frame's last sampled
+        column (device-resident — no host round trip); positions advance by
+        the horizon.  Returns None when the next step is not predictable:
+
+        - a submission is waiting (sync admits, folding prefill keys, first);
+        - any lane is grammar-constrained (the vocab mask is host-derived
+          from last step's token — the structured-output forced-sync case);
+        - any lane will deterministically finish inside the frame being
+          consumed (max_new_tokens / max_seq_len) — the launch would be
+          discarded for certain;
+        - page capacity for the extended horizon isn't available from the
+          free pool (eviction/preemption here would diverge from the sync
+          schedule's, which runs AFTER finishes release pages).
+        """
+        if self.waiting:
+            return None
+        H = frame.horizon
+        ps = self.ps
+        max_seq = self.sched.max_seq_len
+        need = 0
+        for _slot, req, expected in frame.lanes:
+            sp = req.sampling
+            if req.token_filter is not None:
+                return None
+            if len(req.output_ids) + H >= sp.max_new_tokens:
+                return None
+            if req.total_len + H >= max_seq:
+                return None
+            limit = min(expected + 2 * H, max_seq)
+            have = len(req.shared_pages) + len(req.owned_pages)
+            need += max(0, math.ceil(limit / ps) - have)
+        if need > self.pool.free_count:
+            return None
+        for _slot, req, _expected in frame.lanes:
+            # precheck guarantees allocation without eviction or preemption
+            if not self._ensure_seq_capacity(req, 2 * H):
+                return None  # defensive; unreachable after the precheck
+        mp_b = self._mp_bucket(max(
+            math.ceil(min(expected + 2 * H, max_seq) / ps)
+            for _slot, _req, expected in frame.lanes
+        ))
+        positions = frame.positions + np.int32(H)
+        positions[frame.B_real:] = mp_b * ps  # padded rows -> garbage page
+        ds = self._refresh_decode_state(
+            [(s, r) for s, r, _ in frame.lanes], frame.B, mp_b,
+            frame.use_pen, frame.use_lora, frame.use_mrope, frame.lane_sig,
+        )
+        mark = self.runner.rng_mark()
+        toks, lps = self.runner.decode_multi_async(
+            frame.toks[:, -1], positions, ds.page_tables,
+            ds.temps, ds.topks, ds.topps, ds.minps, H,
+            pen=(ds.slot_idx, ds.freqs, ds.pres, ds.reps)
+            if frame.use_pen else None,
+            lora_idx=ds.lora_idx if frame.use_lora else None,
+            rope_delta=ds.rope_delta if frame.use_mrope else None,
+        )
+        return InFlightFrame(
+            lanes=[(s, r, e + H) for s, r, e in frame.lanes],
+            toks=toks, lps=lps, horizon=H, B=frame.B, B_real=frame.B_real,
+            mp_b=mp_b, positions=positions, lane_sig=frame.lane_sig,
+            use_pen=frame.use_pen, use_lora=frame.use_lora,
+            use_mrope=frame.use_mrope, rng_mark=mark, lookahead=True,
+        )
 
     # ---- admission / prefill ----
 
@@ -270,6 +557,7 @@ class Scheduler:
                 all_pages = shared_pages + req.owned_pages
                 row[: len(all_pages)] = all_pages
                 self.slots[slot] = req
+                self._pages_dirty = True
 
                 remaining = len(prompt) - matched_tokens
                 if remaining > self.sched.max_prefill_tokens:
@@ -489,6 +777,8 @@ class Scheduler:
     # ---- decode ----
 
     def _decode(self, outputs: list[StepOutput]) -> None:
+        """Synchronous decode: plan + launch + immediate consume (the overlap
+        pipeline calls the same launch/consume halves with a frame between)."""
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return
@@ -496,6 +786,89 @@ class Scheduler:
             active = self._decode_speculative(active, outputs)
             if not active:
                 return
+        frame = self._launch_frame(active)
+        if frame is not None:
+            self._consume_frame(frame, outputs)
+
+    def _refresh_decode_state(
+        self, active: list, B: int, mp_b: int,
+        use_pen: bool, use_lora: bool, use_mrope: bool, sig: tuple,
+    ) -> DecodeState:
+        """Bring the persistent device-resident decode inputs up to date.
+
+        Sampling params / penalty scalars / LoRA indices change only on batch
+        -composition change (``sig`` mismatch); page tables re-upload only on
+        composition change, mp_b bucket change, or after any host-side row
+        mutation (``_pages_dirty``).  Steady-state decode therefore re-uses
+        resident ``jax.Array``s — ``jnp.asarray`` in the runner is a no-op —
+        instead of ~10 host->device uploads per step."""
+        import jax.numpy as jnp
+
+        ds = self._dstate
+        S = self.sched.max_batch_size  # runner's garbage penalty-state row
+        if ds.lane_sig != sig:
+            temps = np.zeros(B, np.float32)
+            topks = np.full(B, -1, np.int32)
+            topps = np.ones(B, np.float32)
+            minps = np.zeros(B, np.float32)
+            slot_idx = np.full(B, S, np.int32)
+            freqs = np.zeros(B, np.float32)
+            pres = np.zeros(B, np.float32)
+            reps = np.ones(B, np.float32)
+            lora_idx = np.zeros(B, np.int32) if use_lora else None
+            rope_delta = np.zeros(B, np.int32) if use_mrope else None
+            for idx, (slot, req) in enumerate(active):
+                sp = req.sampling
+                temps[idx] = sp.temperature
+                topks[idx] = sp.top_k
+                topps[idx] = sp.top_p
+                minps[idx] = sp.min_p
+                if use_pen:
+                    slot_idx[idx] = slot
+                    if sp.has_penalties:
+                        freqs[idx] = sp.frequency_penalty
+                        pres[idx] = sp.presence_penalty
+                        reps[idx] = sp.repetition_penalty
+                if use_mrope:
+                    rope_delta[idx] = req.mrope_delta
+                if use_lora:
+                    lora_idx[idx] = req.lora_idx
+            ds.temps = jnp.asarray(temps)
+            ds.topks = jnp.asarray(topks)
+            ds.topps = jnp.asarray(topps)
+            ds.minps = jnp.asarray(minps)
+            if use_pen:
+                ds.slot_idx = jnp.asarray(slot_idx)
+                ds.freqs = jnp.asarray(freqs)
+                ds.pres = jnp.asarray(pres)
+                ds.reps = jnp.asarray(reps)
+            ds.lora_idx = jnp.asarray(lora_idx) if use_lora else None
+            ds.rope_delta = jnp.asarray(rope_delta) if use_mrope else None
+            ds.lane_sig = sig
+            ds.pt_sig = None
+        if use_pen:
+            # runner-side counts rows re-derive lazily (admission, preemption
+            # readmit, discarded-lookahead rollback) regardless of sig reuse
+            for slot, req in active:
+                if req.sampling.has_penalties and not req.penalty_synced:
+                    self.runner.sync_slot_penalty_state(
+                        slot, req.prompt_ids, req.output_ids
+                    )
+                    req.penalty_synced = True
+        pt_sig = (sig, mp_b)
+        if ds.pt_sig != pt_sig or self._pages_dirty:
+            page_tables = np.zeros((B, mp_b), np.int32)
+            for idx, (slot, _req) in enumerate(active):
+                page_tables[idx] = self.page_tables[slot][:mp_b]
+            ds.page_tables = jnp.asarray(page_tables)
+            ds.pt_sig = pt_sig
+            self._pages_dirty = False
+        return ds
+
+    def _launch_frame(self, active: list) -> InFlightFrame | None:
+        """Plan + dispatch one decode horizon for ``active`` slots; returns
+        the in-flight frame (results unmaterialized) or None when capacity
+        pressure evicted every candidate."""
         # constrained requests need a fresh host-derived vocab mask per token,
         # so a batch containing one collapses the horizon to single-step
         use_mask = any(r.token_filter is not None for _, r in active)
@@ -512,85 +885,55 @@ class Scheduler:
                 survivors.append((i, req))
         active = [(i, r) for i, r in survivors if self.slots[i] is r]
         if not active:
-            return
+            return None
 
         B_real = len(active)
         B = self.sched.decode_bucket(B_real)
         V = self.runner.model_cfg.vocab_size
-        S = self.sched.max_batch_size  # runner's garbage penalty-state row
         # Trim the page table to the pages LIVE this horizon (bucketed so jit
         # variants stay bounded): the XLA decode attention gathers
         # B*mp*page_size tokens of KV per layer, so rows sized to max_seq_len
         # make every decode pay for the worst-case context.  A batch at mean
         # context 256 of max 8192 reads 32x less with trimmed rows.
-        pages_needed = max(
+        mp_b = self._mp_bucket(max(
             math.ceil(min(r.seq_len + horizon, self.sched.max_seq_len) / self.ps)
             for _, r in active
+        ))
+        sig = (
+            B, use_pen, use_lora, use_mrope,
+            tuple((i, r.sched_serial) for i, r in active),
         )
-        mp_b = 8
-        while mp_b < pages_needed:
-            mp_b *= 2
-        mp_b = min(mp_b, self.mp)
+        ds = self._refresh_decode_state(
+            active, B, mp_b, use_pen, use_lora, use_mrope, sig
+        )
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
-        page_tables = np.zeros((B, mp_b), np.int32)
-        temps = np.zeros(B, np.float32)
-        topks = np.full(B, -1, np.int32)
-        topps = np.ones(B, np.float32)
-        minps = np.zeros(B, np.float32)
-        slot_idx = np.full(B, S, np.int32)
-        freqs = np.zeros(B, np.float32)
-        pres = np.zeros(B, np.float32)
-        reps = np.ones(B, np.float32)
-        lora_idx = np.zeros(B, np.int32) if use_lora else None
         mask_arr = np.ones((B, V), bool) if use_mask else None
-        rope_delta = np.zeros(B, np.int32) if use_mrope else None
         for idx, (slot, req) in enumerate(active):
             tokens[idx] = req.output_ids[-1]
             positions[idx] = req.seq_len
-            if use_mrope:
-                rope_delta[idx] = req.mrope_delta
-            page_tables[idx] = self.page_tables[slot][:mp_b]
-            sp = req.sampling
-            temps[idx] = sp.temperature
-            topks[idx] = sp.top_k
-            topps[idx] = sp.top_p
-            minps[idx] = sp.min_p
-            if use_pen:
-                slot_idx[idx] = slot
-                if sp.has_penalties:
-                    freqs[idx] = sp.frequency_penalty
-                    pres[idx] = sp.presence_penalty
-                    reps[idx] = sp.repetition_penalty
-                    if not req.penalty_synced:
-                        self.runner.sync_slot_penalty_state(
-                            slot, req.prompt_ids, req.output_ids
-                        )
-                        req.penalty_synced = True
             if use_mask and req.token_filter is not None:
                 mask_arr[idx] = self._mask_for(req)
-            if use_lora:
-                lora_idx[idx] = req.lora_idx
         # padded rows: positions land beyond mp_b*ps so writes hit the garbage page
         for idx in range(B_real, B):
             positions[idx] = mp_b * self.ps
 
-        toks, lps = self.runner.decode_multi(
-            tokens, positions, page_tables, temps, topks, topps, minps, horizon,
-            pen=(slot_idx, freqs, pres, reps) if use_pen else None,
+        mark = self.runner.rng_mark()
+        toks, lps = self.runner.decode_multi_async(
+            tokens, positions, ds.page_tables,
+            ds.temps, ds.topks, ds.topps, ds.minps, horizon,
+            pen=(ds.slot_idx, ds.freqs, ds.pres, ds.reps) if use_pen else None,
             mask=mask_arr,
-            lora_idx=lora_idx,
-            rope_delta=rope_delta,
+            lora_idx=ds.lora_idx if use_lora else None,
+            rope_delta=ds.rope_delta if use_mrope else None,
         )
-        self.num_decode_tokens += B_real * horizon
-        for idx, (slot, req) in enumerate(active):
-            self._accept_tokens(
-                req,
-                [int(t) for t in toks[idx]],
-                [float(x) for x in lps[idx]],
-                outputs,
-                advance_seq=True,
-            )
+        return InFlightFrame(
+            lanes=[(i, r, r.seq_len) for i, r in active],
+            toks=toks, lps=lps, horizon=horizon, B=B, B_real=B_real,
+            mp_b=mp_b, positions=positions, lane_sig=sig,
+            use_pen=use_pen, use_lora=use_lora, use_mrope=use_mrope,
+            rng_mark=mark, lookahead=False,
+        )
 
     def _decode_speculative(self, active, outputs: list[StepOutput]):
         """Run spec-eligible slots through draft+verify; returns the slots
@@ -673,13 +1016,9 @@ class Scheduler:
             chunk = [req.output_ids[-1]] + proposals
             # trim the page table to live pages (same 32x-gather argument as
             # the batched decode path above)
-            pages_needed = math.ceil(
+            mp_b = self._mp_bucket(math.ceil(
                 min(req.seq_len + len(chunk), self.sched.max_seq_len) / self.ps
-            )
-            mp_b = 8
-            while mp_b < pages_needed:
-                mp_b *= 2
-            mp_b = min(mp_b, self.mp)
+            ))
             seq_before = req.seq_len
             rope_pos = self._mrope_chunk(req, req.seq_len, len(chunk))
             if sp.temperature == 0.0:
@@ -754,6 +1093,7 @@ class Scheduler:
             page = self.pool.alloc(1)[0]
             req.owned_pages.append(page)
             self.page_tables[req.slot][have] = page
+            self._pages_dirty = True
             have += 1
         return True
 
@@ -772,6 +1112,7 @@ class Scheduler:
         slot = req.slot
         self.slots[slot] = None
         self.page_tables[slot][:] = 0
+        self._pages_dirty = True
         req.slot = None
         self.pool.free(req.owned_pages)
         req.owned_pages = []
@@ -801,6 +1142,7 @@ class Scheduler:
         in owned pages past seq_len, which never enter the radix cache."""
         sp = req.sampling
         accepted: list[int] = []
+        accepted_lps: list[float] = []
         finish: FinishInfo | None = None
         for tok, lp in zip(toks, lps):
             if advance_seq:
@@ -808,6 +1150,7 @@ class Scheduler:
             req.output_ids.append(tok)
             req.logprobs.append(lp)
             accepted.append(tok)
+            accepted_lps.append(lp)
             if not sp.ignore_eos and tok in self.config.model.eos_token_ids:
                 finish = FinishInfo(reason="stop", matched_stop=tok)
             elif tok in sp.stop_token_ids:
@@ -820,7 +1163,10 @@ class Scheduler:
                 break
         if finish is not None:
             self._release(req, finish)
-        outputs.append(StepOutput(req, accepted, finish is not None, finish))
+        outputs.append(
+            StepOutput(req, accepted, finish is not None, finish,
+                       logprobs=accepted_lps)
+        )
 
     # ---- PD disaggregation (SURVEY.md §2.5: PrefillDecode routing mode) ----
 
@@ -876,6 +1222,9 @@ class Scheduler:
             return False
         if req.rid in self.requests:
             raise ValueError(f"duplicate request id {req.rid}")
+        self._serial += 1
+        req.sched_serial = self._serial  # DecodeState lane signatures key
+        # off this; a stale -1 here would alias successive adoptees' params
         self.requests[req.rid] = req
         req.owned_pages = list(pages)
         req.seq_len = req.prompt_len
@@ -886,6 +1235,7 @@ class Scheduler:
         row[:] = 0
         row[: len(pages)] = pages
         self.slots[slot] = req
+        self._pages_dirty = True
         # first_token is accepted by the caller (stop checks + client emission)
         del first_token
         return True
@@ -915,6 +1265,7 @@ class Scheduler:
         self._count_finish(finish.reason)
         if req.slot is not None:
             self.page_tables[req.slot][:] = 0
+            self._pages_dirty = True
             self.slots[req.slot] = None
             req.slot = None
 

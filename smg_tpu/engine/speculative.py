@@ -19,6 +19,13 @@ ON DEVICE via rejection sampling specialized to a deterministic draft
 Monte-Carlo-pinned by tests), and a configured draft MODEL
 (``engine/draft.py``, ``EngineConfig.draft_model``) replaces n-gram lookup
 as the proposer.
+
+Overlap interaction: the speculative path FORCES A SYNC BOUNDARY in the
+overlapped decode pipeline (``scheduler.step`` falls back to the
+synchronous schedule when ``speculative`` is on).  Both the n-gram lookup
+and the verify-chunk construction consume last step's host-side results
+(accepted tokens, acceptance counts), so there is no device work that
+could be dispatched ahead of them.
 """
 
 from __future__ import annotations

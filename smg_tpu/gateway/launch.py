@@ -74,6 +74,7 @@ def build_engine_from_args(args):
             max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len,
             speculative=getattr(args, "speculative", False),
             spec_max_draft=getattr(args, "spec_max_draft", 8),
+            overlap_schedule=getattr(args, "overlap_schedule", "on") != "off",
         ),
         model_id=args.model_path or args.model_preset,
         dtype=getattr(args, "dtype", "bfloat16"),

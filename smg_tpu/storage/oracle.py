@@ -35,6 +35,21 @@ from smg_tpu.utils import get_logger
 logger = get_logger("storage.oracle")
 
 ORA_NAME_EXISTS = "ORA-00955"
+ORA_UNIQUE_VIOLATION = "ORA-00001"
+
+
+class _RawSql(str):
+    """Module-private marker for SQL expressions that must splice verbatim
+    (sequence NEXTVAL).  ``_insert`` quotes every value EXCEPT instances of
+    this class — a client-controlled string can therefore never reach the
+    statement unquoted, no matter what it ends with (the old sentinel,
+    "ends with .NEXTVAL", let hostile ids/metadata splice raw SQL)."""
+
+    __slots__ = ()
+
+
+#: the only raw expression this backend ever inserts
+_ITEM_SEQ_NEXTVAL = _RawSql("smg_item_seq.NEXTVAL")
 
 #: logical schema: (logical column, oracle type) per logical table
 LOGICAL_TABLES = {
@@ -162,9 +177,17 @@ class OracleStorage(ConversationStorage, ConversationItemStorage, ResponseStorag
         for i, batch in enumerate(migs[version:], start=version + 1):
             for stmt in batch:
                 await self._exec_ignore_exists(stmt)
-            await self.client.query(
-                f"INSERT INTO smg_migrations VALUES ({i}, {time.time()})"
-            )
+            try:
+                await self.client.query(
+                    f"INSERT INTO smg_migrations VALUES ({i}, {time.time()})"
+                )
+            except Exception as e:
+                if ORA_UNIQUE_VIOLATION not in str(e):
+                    raise
+                # a concurrent migrator recorded this version first (PK race
+                # on `version`); the DDL batches are identical and idempotent
+                # under the ORA-00955 handler, so the loser continues instead
+                # of failing its first request
         self._migrated = True
 
     @staticmethod
@@ -188,8 +211,7 @@ class OracleStorage(ConversationStorage, ConversationItemStorage, ResponseStorag
                 continue
             cols.append(tc.col(name))
             v = values[name]
-            vals.append(v if isinstance(v, str) and v.endswith(".NEXTVAL")
-                        else q(v))
+            vals.append(v if isinstance(v, _RawSql) else q(v))
         return (f"INSERT INTO {tc.name} ({', '.join(cols)}) "
                 f"VALUES ({', '.join(vals)})")
 
@@ -276,7 +298,7 @@ class OracleStorage(ConversationStorage, ConversationItemStorage, ResponseStorag
                 "item_type": item.type, "role": item.role,
                 "content": json.dumps(item.content),
                 "created_at": item.created_at,
-                "seq": "smg_item_seq.NEXTVAL",
+                "seq": _ITEM_SEQ_NEXTVAL,
             }))
         return items
 

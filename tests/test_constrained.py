@@ -235,6 +235,38 @@ def test_regex_machine_prefix_and_complete():
     assert not neg.accepts('"a"b')
 
 
+def test_regex_char_class_escaped_range_endpoints():
+    """[\\t-z] must parse as the RANGE \\t..z, not the set {'\\t','-','z'}
+    (the old parser flattened the escape and lost the pending range)."""
+    from smg_tpu.constrained.regex_fsm import RegexMachine
+
+    m = RegexMachine(r"[\t-z]+")
+    for ok in ["\t", "a", "z", " ", "\n", "A9 z"]:  # \n = 0x0a is in range
+        assert m.complete(ok), repr(ok)
+    assert not m.complete("{") and not m.complete("\x08")
+
+    # escaped HIGH endpoint: '!'..'\\'
+    hi = RegexMachine(r"[!-\\]")
+    assert hi.complete("!") and hi.complete("\\") and hi.complete("@")
+    assert not hi.complete("]")
+
+    # trailing '-' stays literal; class escapes never form ranges
+    lit = RegexMachine(r"[a-]")
+    assert lit.complete("a") and lit.complete("-")
+    digits = RegexMachine(r"[\d-]")
+    assert digits.complete("7") and digits.complete("-")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        RegexMachine(r"[a-\d]")  # class escape as range endpoint
+    with _pytest.raises(ValueError):
+        RegexMachine(r"[z-a]")  # inverted range
+
+    # negated class over an escaped-endpoint range
+    negr = RegexMachine(r"[^\t-z]")
+    assert negr.complete("{") and not negr.complete("a")
+
+
 def test_ebnf_machine_prefix_complete_and_recursion():
     from smg_tpu.constrained.ebnf import EbnfMachine, GrammarError
 

@@ -116,6 +116,73 @@ def test_oracle_migrations_are_versioned_and_rerun_safe():
     asyncio.run(go())
 
 
+def test_oracle_insert_never_splices_client_strings():
+    """A client-controlled value ending in ".NEXTVAL" (the old raw-splice
+    sentinel) must be quoted like any other string — only the module-private
+    _RawSql wrapper goes in verbatim."""
+    from smg_tpu.storage.oracle import _RawSql
+
+    fake = FakeOracle()
+    s = OracleStorage(fake)
+    hostile = "evil.NEXTVAL"
+
+    async def go():
+        conv = await s.create_conversation({"k": "v"})
+        await s.add_items(conv.id, [ConversationItem(
+            type=hostile, role="user", content={"content": "hi"})])
+        inserts = [x for x in fake.sql_log
+                   if x.startswith("INSERT INTO conversation_items")]
+        # the client value is a quoted literal; only the seq column splices
+        assert "'evil.NEXTVAL'" in inserts[0]
+        assert inserts[0].rstrip().endswith("smg_item_seq.NEXTVAL)")
+        # quote-splicing data survives the roundtrip as data
+        tricky = "x', (SELECT 1), 'y"
+        await s.add_items(conv.id, [ConversationItem(
+            type="message", role=tricky, content={"content": "z"})])
+        items = await s.list_items(conv.id)
+        assert items[-1].role == tricky
+        # _insert only honors the module-private wrapper, not plain strings
+        sql = s._insert("conversation_items", {
+            "id": "i", "conversation_id": "c", "item_type": "t",
+            "created_at": 0.0, "seq": _RawSql("smg_item_seq.NEXTVAL"),
+        })
+        assert sql.rstrip().endswith("smg_item_seq.NEXTVAL)")
+
+    asyncio.run(go())
+
+
+def test_oracle_migration_version_race_absorbed():
+    """Two migrators race the smg_migrations INSERT: the loser hits
+    ORA-00001 (PK on version) and must carry on, not surface the error."""
+
+    class RacingOracle(FakeOracle):
+        async def query(self, sql: str):
+            if sql.startswith("INSERT INTO smg_migrations"):
+                raise RuntimeError(
+                    "ORA-00001: unique constraint (SMG_MIGRATIONS.PK) violated"
+                )
+            return await super().query(sql)
+
+    fake = RacingOracle()
+    s = OracleStorage(fake)
+
+    async def go():
+        await s._ensure()  # must not raise
+        assert s._migrated
+        # non-unique-violation errors still surface
+        class BrokenOracle(FakeOracle):
+            async def query(self, sql: str):
+                if sql.startswith("INSERT INTO smg_migrations"):
+                    raise RuntimeError("ORA-00942: table or view does not exist")
+                return await super().query(sql)
+
+        s2 = OracleStorage(BrokenOracle())
+        with pytest.raises(RuntimeError, match="ORA-00942"):
+            await s2._ensure()
+
+    asyncio.run(go())
+
+
 def test_oracle_schema_remapping():
     """Point the backend at an EXISTING physical schema: renamed tables and
     columns, an extra column, and a skipped one (schema.rs semantics)."""

@@ -1,0 +1,341 @@
+"""Overlap-pipeline parity: the one-step-lookahead scheduler must produce
+token streams BYTE-IDENTICAL to the synchronous path in every scenario —
+greedy, seeded sampling, stop-string rollback mid-lookahead, abort of an
+in-flight request, the speculative sync boundary, and structured-output
+forced sync.  Each test runs the same workload through a fresh engine with
+``overlap_schedule`` on and off (fresh engines so the sampling-key counter
+starts identically) and compares full per-request streams."""
+
+import pytest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+
+def make_engine(overlap: bool, num_pages=128, max_batch=8, max_seq_len=256,
+                **sched_kw) -> Engine:
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=num_pages, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=max_batch,
+            max_seq_len=max_seq_len,
+            max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64),
+            decode_batch_buckets=(4, 8),
+            overlap_schedule=overlap,
+            **sched_kw,
+        ),
+        dtype="float32",
+    )
+    return Engine(cfg, tokenizer=MockTokenizer())
+
+
+def run_streams(engine: Engine, jobs: list) -> dict:
+    """Submit ``jobs`` = [(rid, prompt_ids, sampling)] concurrently, drive
+    the step loop inline to completion, and return the full stream per rid:
+    (token_ids, text, finish_reason, matched_stop, logprobs)."""
+    chunks: dict[str, list] = {rid: [] for rid, _, _ in jobs}
+    done: set[str] = set()
+
+    def cb(out):
+        chunks[out.rid].append(out)
+        if out.finished:
+            done.add(out.rid)
+
+    for rid, prompt, sampling in jobs:
+        engine.submit(prompt, sampling, rid=rid, on_output=cb)
+    for _ in range(5000):
+        if len(done) == len(jobs):
+            # drain the pipeline (a kept lookahead may still be in flight)
+            while engine.scheduler.has_work():
+                engine.step()
+            break
+        engine.step()
+    else:
+        raise TimeoutError(f"jobs stuck: {engine.loads()}")
+    out = {}
+    for rid, _, _ in jobs:
+        toks = [t for c in chunks[rid] for t in c.new_token_ids]
+        text = "".join(c.text_delta for c in chunks[rid])
+        lps = [round(x, 4) for c in chunks[rid] for x in c.logprobs]
+        last = chunks[rid][-1]
+        out[rid] = (toks, text, last.finish_reason, last.matched_stop, lps)
+    return out
+
+
+def assert_parity(jobs, **engine_kw):
+    a = run_streams(make_engine(True, **engine_kw), jobs)
+    b = run_streams(make_engine(False, **engine_kw), jobs)
+    assert a == b, f"overlap diverged from sync:\n{a}\nvs\n{b}"
+    return a
+
+
+def greedy(max_new=8, **kw) -> SamplingParams:
+    return SamplingParams(temperature=0.0, max_new_tokens=max_new,
+                          ignore_eos=True, **kw)
+
+
+def test_greedy_parity_concurrent_batch():
+    jobs = [
+        (f"g{i}", list(range(5 + i, 25 + 3 * i)), greedy(6 + 2 * i))
+        for i in range(4)
+    ]
+    assert_parity(jobs)
+
+
+def test_greedy_parity_with_horizon():
+    jobs = [(f"h{i}", list(range(10 + i, 40 + i)), greedy(13)) for i in range(3)]
+    assert_parity(jobs, decode_horizon=4)
+
+
+def test_seeded_sampling_parity():
+    jobs = [
+        ("s0", list(range(40, 80)),
+         SamplingParams(temperature=0.9, top_k=40, top_p=0.95,
+                        max_new_tokens=12, ignore_eos=True)),
+        ("s1", list(range(90, 120)),
+         SamplingParams(temperature=0.7, min_p=0.05, max_new_tokens=10,
+                        ignore_eos=True)),
+        ("s2", list(range(130, 150)),
+         SamplingParams(temperature=1.1, frequency_penalty=0.4,
+                        presence_penalty=0.2, max_new_tokens=9,
+                        ignore_eos=True)),
+    ]
+    assert_parity(jobs)
+
+
+def test_eos_and_stop_token_parity():
+    # natural EOS finishes (ignore_eos off) and stop_token_ids both cut the
+    # stream mid-flight, which is exactly what invalidates a lookahead
+    probe = run_streams(
+        make_engine(False), [("p", list(range(5, 15)), greedy(6))]
+    )["p"][0]
+    stop_tok = probe[3]
+    jobs = [
+        ("e0", list(range(5, 15)),
+         SamplingParams(temperature=0.0, max_new_tokens=32)),
+        ("e1", list(range(5, 15)),
+         SamplingParams(temperature=0.0, max_new_tokens=32, ignore_eos=True,
+                        stop_token_ids=[stop_tok])),
+    ]
+    res = assert_parity(jobs)
+    assert res["e1"][2] == "stop" and res["e1"][3] == stop_tok
+
+
+def test_stop_string_rollback_mid_lookahead():
+    # the stop string is found at the ENGINE layer after the scheduler step
+    # returned, with the next lookahead frame already in flight: the engine
+    # rolls back trailing tokens and finishes the request, and the kept
+    # frame must be discarded without corrupting any other stream
+    probe = run_streams(
+        make_engine(False), [("p", list(range(60, 90)), greedy(8))]
+    )["p"][0]
+    stop_word = f"w{probe[2]}"
+    jobs = [
+        ("r0", list(range(60, 90)),
+         SamplingParams(temperature=0.0, max_new_tokens=16, ignore_eos=True,
+                        stop=[stop_word])),
+        ("r1", list(range(7, 31)), greedy(14)),  # rides alongside, unaffected
+    ]
+    res = assert_parity(jobs)
+    assert res["r0"][2] == "stop" and res["r0"][3] == stop_word
+    assert not res["r0"][1].endswith(stop_word)
+
+
+def test_stop_string_rollback_with_horizon():
+    probe = run_streams(
+        make_engine(False, decode_horizon=4),
+        [("p", list(range(60, 90)), greedy(8))],
+    )["p"][0]
+    stop_word = f"w{probe[2]}"
+    jobs = [
+        ("r0", list(range(60, 90)),
+         SamplingParams(temperature=0.0, max_new_tokens=16, ignore_eos=True,
+                        stop=[stop_word])),
+        ("r1", list(range(7, 31)), greedy(14)),
+    ]
+    res = assert_parity(jobs, decode_horizon=4)
+    assert res["r0"][2] == "stop"
+
+
+def test_abort_of_inflight_request():
+    eng = make_engine(True)
+    got: dict[str, list] = {"a": [], "b": []}
+    eng.submit(list(range(5, 25)), greedy(64), rid="a",
+               on_output=lambda o: got["a"].append(o))
+    eng.submit(list(range(30, 55)), greedy(10), rid="b",
+               on_output=lambda o: got["b"].append(o))
+    for _ in range(3):
+        eng.step()
+    assert eng.abort("a")
+    for _ in range(200):
+        if got["b"] and got["b"][-1].finished:
+            break
+        eng.step()
+    assert got["b"][-1].finished and got["b"][-1].finish_reason == "length"
+    # the aborted request's lanes went stale with its frame in flight; the
+    # survivor's stream must equal a run where "a" never existed past abort
+    while eng.scheduler.has_work():
+        eng.step()
+    assert eng.scheduler.inflight is None
+    assert all(s is None for s in eng.scheduler.slots)
+    # no page leak: everything not held by the radix cache is back in the pool
+    sched = eng.scheduler
+    held = sched.radix.num_cached_pages if sched.radix else 0
+    assert sched.pool.free_count + held == eng.runner.spec.num_pages - 1
+
+
+def test_speculative_forces_sync_boundary():
+    # the spec path's next device call depends on last step's host results,
+    # so overlap must transparently fall back to the synchronous schedule —
+    # identical streams, and the pipeline never engages
+    rep = [5, 6, 7, 8] * 8
+    jobs = [("sp", rep, greedy(16))]
+    res = assert_parity(jobs, speculative=True, spec_max_draft=6)
+    eng = make_engine(True, speculative=True, spec_max_draft=6)
+    streams = run_streams(eng, jobs)
+    assert streams == res
+    assert eng.scheduler.num_lookahead_kept == 0
+    assert eng.scheduler.inflight is None
+    assert eng.scheduler.num_spec_drafted > 0  # spec really ran
+
+
+def test_structured_output_forces_sync():
+    # grammar-masked requests need a host-derived vocab mask per token
+    # (depends on last step's token), so no lookahead may be launched while
+    # one is active — but the stream must still match the sync path
+    jobs = [
+        ("j0", list(range(20, 50)),
+         SamplingParams(temperature=0.0, max_new_tokens=6, ignore_eos=True,
+                        regex=r"w[0-9 ]*")),
+        ("j1", list(range(70, 95)), greedy(6)),
+    ]
+    res = assert_parity(jobs)
+    assert res["j0"][0]  # produced tokens under the grammar
+    eng = make_engine(True)
+    run_streams(eng, jobs)
+    assert eng.scheduler.num_lookahead_kept == 0
+
+
+def test_lookahead_engages_and_counters_exposed():
+    eng = make_engine(True)
+    run_streams(eng, [(f"l{i}", list(range(5 + i, 30 + i)), greedy(16))
+                      for i in range(3)])
+    loads = eng.loads()
+    assert loads["lookahead_kept"] > 0
+    assert "lookahead_discarded" in loads
+    # sync engines never engage the pipeline
+    eng2 = make_engine(False)
+    run_streams(eng2, [("x", list(range(5, 30)), greedy(8))])
+    assert eng2.loads()["lookahead_kept"] == 0
+
+
+def test_overlap_metrics_recorded():
+    from prometheus_client import generate_latest
+
+    eng = make_engine(True)
+    probe = run_streams(eng, [("m", list(range(5, 30)), greedy(12))])
+    # a stop-token finish is UNPREDICTED at lookahead-launch time (unlike a
+    # length finish, which suppresses the launch), so it forces a discard
+    stop_tok = probe["m"][0][4]
+    run_streams(eng, [
+        ("d", list(range(5, 30)),
+         SamplingParams(temperature=0.0, max_new_tokens=32, ignore_eos=True,
+                        stop_token_ids=[stop_tok])),
+        ("d2", list(range(31, 55)), greedy(20)),
+    ])
+    text = generate_latest(eng.metrics.registry).decode()
+    assert 'smg_engine_lookahead_launches_total{outcome="kept"}' in text
+    assert 'smg_engine_lookahead_launches_total{outcome="discarded"}' in text
+    assert "smg_engine_deferred_fetch_seconds" in text
+    assert "smg_engine_overlap_host_busy_seconds_total" in text
+    assert "smg_engine_overlap_device_wait_seconds_total" in text
+    assert eng.scheduler.num_lookahead_discarded > 0
+
+
+def test_submission_behind_kept_lookahead():
+    # submit a second request while the first's lookahead frame is in
+    # flight: sync admits before decoding, so the kept frame must be
+    # discarded and the combined batch must match the sync schedule
+    def run(overlap):
+        eng = make_engine(overlap)
+        got: dict[str, list] = {"a": [], "b": []}
+        eng.submit(list(range(5, 25)), greedy(20), rid="a",
+                   on_output=lambda o: got["a"].append(o))
+        for _ in range(4):
+            eng.step()
+        eng.submit(list(range(40, 70)), greedy(12), rid="b",
+                   on_output=lambda o: got["b"].append(o))
+        for _ in range(300):
+            if all(v and v[-1].finished for v in got.values()):
+                break
+            eng.step()
+        while eng.scheduler.has_work():
+            eng.step()
+        return {
+            rid: [t for o in v for t in o.new_token_ids]
+            for rid, v in got.items()
+        }
+
+    assert run(True) == run(False)
+
+
+def test_preemption_under_page_pressure_parity():
+    # tight page pool: growth forces eviction/preemption, which the
+    # lookahead capacity precheck must route through the sync path
+    jobs = [(f"p{i}", list(range(5 + 17 * i, 37 + 17 * i)), greedy(24))
+            for i in range(4)]
+    a = run_streams(make_engine(True, num_pages=24, max_batch=4), jobs)
+    b = run_streams(make_engine(False, num_pages=24, max_batch=4), jobs)
+    assert a == b
+
+
+def test_flush_cache_with_stale_inflight_frame():
+    eng = make_engine(True)
+    run_streams(eng, [("f", list(range(5, 30)), greedy(6))])
+    # pipeline drained by run_streams; force a frame then finish everything
+    assert eng.flush_cache()
+    r = eng.generate(prompt_ids=list(range(5, 30)), sampling=greedy(6))
+    assert len(r.token_ids) == 6
+
+
+def test_engine_stop_drops_inflight():
+    eng = make_engine(True)
+    eng.start()
+    eng.submit(list(range(5, 25)), greedy(64), rid="s")
+    import time
+
+    time.sleep(0.3)  # let the loop launch frames
+    eng.stop()
+    assert eng.scheduler.inflight is None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("horizon", [1, 2, 4])
+def test_exhaustive_parity_sweep(horizon):
+    """Randomized stress parity: mixed greedy/sampled/stop/penalty workloads
+    at several horizons, staggered finish lengths so lookahead frames get
+    invalidated at many different points."""
+    import random
+
+    rng = random.Random(horizon)
+    jobs = []
+    for i in range(6):
+        prompt = [rng.randrange(5, 500) for _ in range(rng.randrange(8, 60))]
+        if i % 3 == 0:
+            sp = greedy(rng.randrange(3, 20))
+        elif i % 3 == 1:
+            sp = SamplingParams(temperature=0.8, top_k=50,
+                                max_new_tokens=rng.randrange(3, 20),
+                                ignore_eos=True)
+        else:
+            sp = SamplingParams(temperature=0.0,
+                                max_new_tokens=rng.randrange(6, 24),
+                                frequency_penalty=0.3, ignore_eos=True)
+        jobs.append((f"x{i}", prompt, sp))
+    assert_parity(jobs, decode_horizon=horizon)
