@@ -138,7 +138,7 @@ def main() -> dict:
     # the host side in the same band as a horizon-4 decode call of the
     # probe model on an idle CPU — the balanced regime where pipelining is
     # visible (a TPU decode step dwarfs its host work the same way).
-    # Best-of-2 per mode filters ambient load spikes.
+    # Best of 3 interleaved rounds per mode filters ambient load spikes.
     from smg_tpu.models.config import ModelConfig
 
     probe_model = ModelConfig(
@@ -217,6 +217,36 @@ def main() -> dict:
     except Exception as err:  # the probe must not void the gate
         probe = {"error": f"{type(err).__name__}: {err}"[:200]}
 
+    # ---- scenario 5: steady-state retrace/transfer probe (NOT part of the
+    # fingerprint).  After warmup, N decode steps run under
+    # jax.transfer_guard("disallow") with an XLA-compile counter: the
+    # recompile count is reported as a NUMBER so BENCH diffs catch a
+    # retrace regression even when ambient load hides the stall, and any
+    # implicit host<->device transfer raises.  Pairs with the smglint
+    # HOTSYNC/RETRACE static rules (smg_tpu/analysis/).
+    try:
+        from smg_tpu.analysis.runtime_guards import steady_state_guard
+
+        g_eng = probe_engine(True)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=64, ignore_eos=True)
+        for i, p in enumerate(probe_prompts):
+            g_eng.submit(p, sp, rid=f"g{i}")
+        for _ in range(6):  # prefill + pipeline priming + compiles
+            g_eng.step()
+        guarded_steps = 8
+        with steady_state_guard(max_compiles=10_000) as cc:  # report, don't raise
+            for _ in range(guarded_steps):
+                g_eng.step()
+        while g_eng.scheduler.has_work():
+            g_eng.step()
+        steady = {
+            "guarded_steps": guarded_steps,
+            "recompiles": cc.count,  # MUST be 0; BENCH diffs gate on it
+            "transfer_guard": "clean",  # implicit transfer would have raised
+        }
+    except Exception as err:  # the probe must not void the gate
+        steady = {"error": f"{type(err).__name__}: {err}"[:200]}
+
     return {
         "bench": "engine_gate",
         "decode_tok_s": round(decode_tok_s, 1),
@@ -224,6 +254,7 @@ def main() -> dict:
         "spec_accept_rate": round(accepted / drafted, 3) if drafted else None,
         "spec_drafted": drafted,
         "overlap_probe": probe,
+        "steady_state_probe": steady,
         "stream_fingerprint": fingerprint.hexdigest(),
         "seeds": {"weights": 0, "sampler": "seed ^ 0x5EED"},
         "deterministic": True,

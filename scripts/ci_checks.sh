@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Single CI entrypoint for the repo's self-checks:
+#
+#   1. smglint        — AST hot-path & concurrency rules over smg_tpu/
+#                       (HOTSYNC / ASYNCBLOCK / LOCKAWAIT / RETRACE),
+#                       failing on any unbaselined finding;
+#   2. metric docs    — README observability table vs exported smg_* series;
+#   3. runtime guards — transfer-guard + zero-recompile probes on the real
+#                       engine's steady-state decode loop (the runtime teeth
+#                       behind HOTSYNC/RETRACE), via tests/test_analysis.py.
+#
+# Usage: scripts/ci_checks.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== smglint =="
+python scripts/smglint.py smg_tpu/
+
+echo "== metric docs drift =="
+JAX_PLATFORMS=cpu python scripts/check_metric_docs.py
+
+echo "== lint rule suite + runtime guard probes =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
+    -p no:cacheprovider
+
+echo "ci_checks: all green"

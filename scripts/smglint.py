@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Repo-native static analysis: hot-path sync, async-blocking, lock-domain
+and jit-retrace hazards.  Thin wrapper so CI can run it without installing
+the package; the implementation lives in ``smg_tpu/analysis/``.
+
+    python scripts/smglint.py smg_tpu/
+    python scripts/smglint.py smg_tpu/ --write-baseline
+    python scripts/smglint.py smg_tpu/gateway --rules ASYNCBLOCK,LOCKAWAIT
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from smg_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

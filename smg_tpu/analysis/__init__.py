@@ -1,0 +1,40 @@
+"""smglint: repo-native static analysis for performance invariants.
+
+The overlapped decode pipeline (PR 2) and the async gateway only stay fast
+while properties hold that nothing in Python enforces: the steady-state
+decode loop must not sync device→host implicitly, ``jax.jit`` must not
+retrace per step, the event loop must not block, and a ``threading.Lock``
+must never straddle an ``await``.  This package makes those invariants
+machine-checked, the way ``scripts/check_metric_docs.py`` locks the metric
+docs to the exported series:
+
+- an AST rule engine (``core``) with per-line ``# smglint: disable=RULE``
+  suppressions and a checked-in baseline for grandfathered findings;
+- four rule families (``rules``): HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE;
+- runtime guards (``runtime_guards``) pairing the static pass with
+  ``jax.transfer_guard`` + XLA-compile counting around the steady-state
+  decode loop, wired into tests and ``benches/bench_engine.py``.
+
+Lint-only use (``scripts/smglint.py`` / the ``smglint`` console script) has
+no jax dependency; ``runtime_guards`` imports jax lazily.
+"""
+
+from smg_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "apply_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,125 @@
+"""smglint CLI: ``python scripts/smglint.py smg_tpu/`` or the ``smglint``
+console script.
+
+Exit status: 0 = clean (every finding suppressed or baselined), 1 = new
+findings, 2 = usage error.  ``--write-baseline`` grandfathers the current
+findings; CI then fails only on NEW ones, and the baseline file's diff is
+the reviewable record of debt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from smg_tpu.analysis.core import (
+    LintConfig,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = "smglint_baseline.json"
+
+
+def _default_baseline_path(paths: list[str]) -> Path | None:
+    """The checked-in baseline next to pyproject.toml, when one exists."""
+    from smg_tpu.analysis.core import _repo_root
+
+    root = _repo_root(Path(paths[0] if paths else ".").resolve())
+    if root is None:
+        return None
+    cand = root / DEFAULT_BASELINE
+    return cand if cand.exists() else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="smglint",
+        description="AST hot-path & concurrency lint for smg-tpu "
+                    "(HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE)",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default: {DEFAULT_BASELINE} at the "
+                         "repo root, when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings into the baseline and "
+                         "exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (e.g. HOTSYNC,RETRACE)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list suppressed and baselined findings")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = tuple(r.strip().upper() for r in args.rules.split(",") if r.strip())
+    try:
+        config = LintConfig(rules=rules)
+        findings = lint_paths(args.paths, config)
+    except (KeyError, OSError) as e:
+        print(f"smglint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = (
+        Path(args.baseline) if args.baseline else _default_baseline_path(args.paths)
+    )
+    if args.write_baseline:
+        if baseline_path is not None:  # covers an explicit --baseline too
+            target = baseline_path
+        else:
+            # write where the default lookup will find it next run: the repo
+            # root when one exists, else beside the (directory) target
+            from smg_tpu.analysis.core import _repo_root
+
+            root = _repo_root(Path(args.paths[0]).resolve())
+            target = (root or Path(args.paths[0]).resolve().parent) / DEFAULT_BASELINE
+        # a narrowed invocation (--rules subset, or a sub-path of the repo)
+        # regenerates only ITS scope: prior entries for other rules/paths
+        # are carried over, never silently erased
+        from smg_tpu.analysis.core import scope_prefixes, split_baseline_key
+
+        prefixes = scope_prefixes(args.paths)
+        keep: dict[str, int] = {}
+        for key, n in load_baseline(target).items():
+            krule, kpath, _ = split_baseline_key(key)
+            in_scope = (rules is None or krule in rules) and any(
+                kpath == pre or (pre.endswith("/") and kpath.startswith(pre))
+                for pre in prefixes
+            )
+            if not in_scope:
+                keep[key] = n
+        write_baseline(findings, target, keep=keep)
+        n = sum(1 for f in findings if not f.suppressed)
+        extra = f" (+{len(keep)} out-of-scope entr{'y' if len(keep) == 1 else 'ies'} kept)" if keep else ""
+        print(f"smglint: wrote {n} baselined finding(s) to {target}{extra}")
+        return 0
+    if baseline_path is not None and not args.no_baseline:
+        findings = apply_baseline(findings, load_baseline(baseline_path))
+
+    new = [f for f in findings if not f.suppressed and not f.baselined]
+    shown = findings if args.show_suppressed else new
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in shown], indent=2))
+    else:
+        for f in shown:
+            print(f.render())
+        n_sup = sum(1 for f in findings if f.suppressed)
+        n_base = sum(1 for f in findings if f.baselined)
+        status = "FAIL" if new else "ok"
+        print(
+            f"smglint: {status} — {len(new)} new finding(s), "
+            f"{n_base} baselined, {n_sup} suppressed"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

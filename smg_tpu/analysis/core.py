@@ -1,0 +1,422 @@
+"""smglint core: finding model, module context, suppressions, baseline.
+
+The engine is deliberately small: rules are plain objects with a ``check``
+method receiving a :class:`ModuleContext` (parsed AST + parent links + the
+raw source lines) and yielding :class:`Finding`.  Everything stateful —
+suppression comments, the baseline file, path scoping — lives here so rules
+stay pure pattern matchers.
+
+Suppression syntax (flake8-style, but namespaced so ``# noqa`` sweeps never
+silence performance invariants by accident)::
+
+    x = arr.item()          # smglint: disable=HOTSYNC  <why this is fine>
+    # smglint: disable-next=HOTSYNC <why>               (covers the next line)
+    # smglint: disable-file=ASYNCBLOCK                  (anywhere in the file)
+
+Baseline workflow: ``scripts/smglint.py --write-baseline`` records every
+current finding keyed by ``rule:path:<hash of the stripped source line>`` —
+line-number independent, so unrelated edits above a grandfathered finding
+don't resurrect it, while editing the offending line itself does.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Iterable, Iterator
+
+# rule tokens only (comma-separated): a trailing justification — even one
+# starting with an uppercase word, "KV export helper" — must not be
+# swallowed into the rule list and silently void the suppression
+_RULES_PAT = r"([A-Z0-9_*]+(?:\s*,\s*[A-Z0-9_*]+)*)"
+_SUPPRESS_RE = re.compile(r"#\s*smglint:\s*disable=" + _RULES_PAT)
+_SUPPRESS_NEXT_RE = re.compile(r"#\s*smglint:\s*disable-next=" + _RULES_PAT)
+_SUPPRESS_FILE_RE = re.compile(r"#\s*smglint:\s*disable-file=" + _RULES_PAT)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at ``path:line:col`` (1-based line, 0-based col)."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    snippet: str = ""  # stripped source line — the baseline identity
+    suppressed: bool = False
+    baselined: bool = False
+    # last line of the offending STATEMENT: a trailing suppression comment on
+    # any line of a multi-line call must still cover the finding, which
+    # anchors at the first line
+    end_line: int = 0
+
+    @property
+    def baseline_key(self) -> str:
+        digest = hashlib.blake2b(
+            self.snippet.encode("utf-8", "replace"), digest_size=6
+        ).hexdigest()
+        return f"{self.rule}:{self.path}:{digest}"
+
+    def render(self) -> str:
+        tags = "".join(
+            f" [{t}]" for t, on in (("suppressed", self.suppressed),
+                                    ("baselined", self.baselined)) if on
+        )
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tags}"
+
+
+@dataclass
+class LintConfig:
+    """Scoping knobs; defaults encode this repo's layout."""
+
+    # modules where implicit device→host syncs are latency bugs (HOTSYNC)
+    hot_paths: tuple[str, ...] = (
+        "smg_tpu/engine/scheduler.py",
+        "smg_tpu/engine/runner.py",
+        "smg_tpu/engine/sampling.py",
+        "smg_tpu/ops/*",
+    )
+    # None = all registered rules
+    rules: tuple[str, ...] | None = None
+
+
+class ModuleContext:
+    """Parsed module + the indexes every rule needs (parents, lines)."""
+
+    def __init__(self, source: str, relpath: str, config: LintConfig):
+        self.source = source
+        self.relpath = relpath.replace("\\", "/")
+        self.config = config
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self._parents: dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+
+    # ---- tree navigation ----
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def in_hot_path(self) -> bool:
+        return matches_any(self.relpath, self.config.hot_paths)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.line_at(line),
+            end_line=getattr(node, "end_lineno", None) or line,
+        )
+
+
+def matches_any(relpath: str, patterns: Iterable[str]) -> bool:
+    """Glob match against the repo-relative path, tolerating absolute or
+    differently-rooted invocations by also matching on path suffixes."""
+    p = relpath.replace("\\", "/")
+    for pat in patterns:
+        if fnmatch.fnmatch(p, pat) or fnmatch.fnmatch(p, "*/" + pat):
+            return True
+    return False
+
+
+# ---- AST helpers shared by rules ----
+
+def dotted_name(node: ast.AST) -> str:
+    """``np.asarray`` for Attribute/Name chains, '' for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def contains_await(nodes: Iterable[ast.AST]) -> ast.AST | None:
+    """First Await / async-with / async-for inside ``nodes``, not descending
+    into nested function definitions (their awaits run on a different
+    call)."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Await, ast.AsyncWith, ast.AsyncFor)):
+            return n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return None
+
+
+def iter_calls(body: Iterable[ast.AST]) -> Iterator[ast.Call]:
+    """Call nodes lexically inside ``body``, not descending into nested
+    function definitions."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---- suppressions ----
+
+def _parse_rule_list(raw: str) -> set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+@dataclass
+class _Suppressions:
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    file_level: set[str] = field(default_factory=set)
+
+    def covers(self, f: Finding) -> bool:
+        if "*" in self.file_level or f.rule in self.file_level:
+            return True
+        # a trailing comment on ANY line of a multi-line statement counts
+        for line in range(f.line, max(f.end_line, f.line) + 1):
+            bag = self.by_line.get(line, ())
+            if "*" in bag or f.rule in bag:
+                return True
+        return False
+
+
+def _iter_comments(source: str, lines: list[str]):
+    """(text, lineno) for actual ``#`` COMMENT tokens only — directive text
+    inside a string literal or docstring (e.g. documentation QUOTING the
+    suppression syntax) must never register as a live suppression."""
+    import io
+    import tokenize
+
+    try:
+        for t in tokenize.generate_tokens(io.StringIO(source).readline):
+            if t.type == tokenize.COMMENT:
+                yield t.string, t.start[0]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unterminated constructs etc.: fall back to raw lines (the module
+        # failed ast.parse anyway and reports PARSE, so over-matching here
+        # cannot hide a real finding)
+        yield from ((line, i) for i, line in enumerate(lines, start=1))
+
+
+def _collect_suppressions(source: str, lines: list[str]) -> _Suppressions:
+    sup = _Suppressions()
+    for line, i in _iter_comments(source, lines):
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            sup.file_level |= _parse_rule_list(m.group(1))
+            continue
+        m = _SUPPRESS_NEXT_RE.search(line)
+        if m:
+            # standalone comment covering the next CODE line (for statements
+            # too long to carry a trailing comment); blank and comment-only
+            # lines in between don't swallow the suppression
+            nxt = i + 1
+            while nxt <= len(lines) and (
+                not lines[nxt - 1].strip()
+                or lines[nxt - 1].lstrip().startswith("#")
+            ):
+                nxt += 1
+            sup.by_line.setdefault(nxt, set()).update(_parse_rule_list(m.group(1)))
+            continue
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            sup.by_line.setdefault(i, set()).update(_parse_rule_list(m.group(1)))
+    return sup
+
+
+# ---- entry points ----
+
+def lint_source(
+    source: str, relpath: str, config: LintConfig | None = None
+) -> list[Finding]:
+    """Lint one module's source; returns every finding with ``suppressed``
+    already resolved (callers filter).  Syntax errors are reported as a
+    pseudo-finding rather than raised — a broken file must fail the lint,
+    not crash it."""
+    from smg_tpu.analysis.rules import registered_rules
+
+    config = config or LintConfig()
+    try:
+        ctx = ModuleContext(source, relpath, config)
+    except SyntaxError as e:
+        return [Finding(
+            rule="PARSE", path=relpath, line=e.lineno or 1, col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+        )]
+    sup = _collect_suppressions(ctx.source, ctx.lines)
+    findings: list[Finding] = []
+    for rule in registered_rules(config.rules):
+        for f in rule.check(ctx):
+            if sup.covers(f):
+                f = replace(f, suppressed=True)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _repo_root(start: Path) -> Path | None:
+    """Nearest ancestor carrying pyproject.toml (for repo-relative finding
+    paths), or None outside any project."""
+    cur = start if start.is_dir() else start.parent
+    for cand in (cur, *cur.parents):
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return None
+
+
+def iter_python_files(paths: Iterable[str | Path]) -> Iterator[tuple[Path, str]]:
+    """(absolute path, repo-relative posix path) for every .py under
+    ``paths``; hidden and cache dirs skipped."""
+    for raw in paths:
+        p = Path(raw).resolve()
+        if not p.exists():
+            # a vanished/misspelled path must be a hard error: rglob on a
+            # missing dir yields nothing and the CI gate would pass green
+            # while checking nothing
+            raise OSError(f"smglint path does not exist: {raw}")
+        root = _repo_root(p)
+        files = [p] if p.is_file() else sorted(
+            f for f in p.rglob("*.py")
+            if not any(part.startswith(".") or part == "__pycache__"
+                       for part in f.relative_to(p).parts)
+        )
+        for f in files:
+            try:
+                # no project marker above the path: keep the absolute path —
+                # matches_any suffix-matches hot globs against it, where a
+                # bare filename would lose the directory context
+                rel = f.relative_to(root).as_posix() if root else f.as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            yield f, rel
+
+
+def lint_paths(
+    paths: Iterable[str | Path], config: LintConfig | None = None
+) -> list[Finding]:
+    import tokenize
+
+    findings: list[Finding] = []
+    for abspath, rel in iter_python_files(paths):
+        try:
+            # tokenize.open honors PEP 263 coding declarations and BOMs —
+            # a legal latin-1 module must lint, not traceback
+            with tokenize.open(abspath) as f:
+                source = f.read()
+        except (UnicodeDecodeError, SyntaxError) as e:
+            findings.append(Finding(
+                rule="PARSE", path=rel, line=1, col=0,
+                message=f"cannot decode source: {e}",
+            ))
+            continue
+        findings.extend(lint_source(source, rel, config))
+    return findings
+
+
+# ---- baseline ----
+
+def load_baseline(path: str | Path) -> dict[str, int]:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text())
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def scope_prefixes(paths: Iterable[str | Path]) -> list[str]:
+    """Repo-relative scope of a lint invocation: ``"smg_tpu/"`` for a
+    directory target, the exact relpath for a file target.  Used to merge
+    baselines — entries OUTSIDE the regenerated scope must survive a
+    partial run."""
+    out: list[str] = []
+    for raw in paths:
+        p = Path(raw).resolve()
+        root = _repo_root(p)
+        try:
+            rel = p.relative_to(root).as_posix() if root else p.as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        out.append(rel + "/" if p.is_dir() else rel)
+    return out
+
+
+def split_baseline_key(key: str) -> tuple[str, str, str]:
+    """(rule, path, line_hash) — the path may itself contain ':' on exotic
+    filesystems, so split from both ends."""
+    rule, _, rest = key.partition(":")
+    path, _, digest = rest.rpartition(":")
+    return rule, path, digest
+
+
+def write_baseline(
+    findings: Iterable[Finding],
+    path: str | Path,
+    *,
+    keep: dict[str, int] | None = None,
+) -> None:
+    """Record current findings as grandfathered.  ``keep`` carries prior
+    baseline entries that were OUTSIDE this run's scope (other rules, other
+    paths) and must not be erased by a narrowed invocation."""
+    counts: dict[str, int] = dict(keep or {})
+    for f in findings:
+        if not f.suppressed:
+            counts[f.baseline_key] = counts.get(f.baseline_key, 0) + 1
+    Path(path).write_text(json.dumps(
+        {
+            "comment": "grandfathered smglint findings; regenerate with "
+                       "scripts/smglint.py --write-baseline",
+            "findings": dict(sorted(counts.items())),
+        },
+        indent=2,
+    ) + "\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, int]
+) -> list[Finding]:
+    """Mark findings covered by the baseline (first N occurrences of each
+    key, so a NEW duplicate of a grandfathered line still fails)."""
+    budget = dict(baseline)
+    out: list[Finding] = []
+    for f in findings:
+        if not f.suppressed and budget.get(f.baseline_key, 0) > 0:
+            budget[f.baseline_key] -= 1
+            f = replace(f, baselined=True)
+        out.append(f)
+    return out

@@ -1,0 +1,35 @@
+"""Rule registry: four families, each a pure AST pattern matcher.
+
+| id         | invariant it guards                                          |
+|------------|--------------------------------------------------------------|
+| HOTSYNC    | hot-path modules stay free of implicit device→host syncs     |
+| ASYNCBLOCK | ``async def`` bodies never call blocking APIs                |
+| LOCKAWAIT  | lock kind matches execution domain (thread vs event loop)    |
+| RETRACE    | ``jax.jit`` is constructed once, not per call/iteration      |
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from smg_tpu.analysis.rules.asyncblock import AsyncBlockRule
+from smg_tpu.analysis.rules.hotsync import HotSyncRule
+from smg_tpu.analysis.rules.lockawait import LockAwaitRule
+from smg_tpu.analysis.rules.retrace import RetraceRule
+
+ALL_RULES = {
+    r.id: r
+    for r in (HotSyncRule(), AsyncBlockRule(), LockAwaitRule(), RetraceRule())
+}
+
+
+def registered_rules(only: Iterable[str] | None = None):
+    if only is None:
+        return list(ALL_RULES.values())
+    unknown = set(only) - set(ALL_RULES)
+    if unknown:
+        raise KeyError(f"unknown smglint rule(s): {sorted(unknown)}")
+    return [ALL_RULES[r] for r in only]
+
+
+__all__ = ["ALL_RULES", "registered_rules"]

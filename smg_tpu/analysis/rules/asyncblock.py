@@ -1,0 +1,95 @@
+"""ASYNCBLOCK: blocking calls lexically inside ``async def``.
+
+One ``time.sleep`` or sync HTTP call in a handler stalls EVERY in-flight
+request on the event loop — the gateway serves all streams from one loop,
+so this is a tail-latency bug, not a style nit.  The fix is almost always
+``await asyncio.to_thread(...)`` / ``loop.run_in_executor`` or the async
+-native equivalent (``asyncio.sleep``, aiohttp).
+
+Nested sync ``def``s are NOT scanned: they run on whatever thread calls
+them, which the executor fix makes correct.  Known benign shapes (e.g.
+``task.result()`` on an already-done asyncio task) are suppressions at the
+call site, with the justification in the comment where reviewers look.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    iter_calls,
+)
+
+_BLOCKING_CALLS = {
+    "time.sleep": "blocks the event loop — use `await asyncio.sleep(...)`",
+    "subprocess.run": "blocks the loop on a child process — use "
+                      "`asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "subprocess.call": "blocks the loop on a child process",
+    "subprocess.check_call": "blocks the loop on a child process",
+    "subprocess.check_output": "blocks the loop on a child process",
+    "os.system": "blocks the loop on a shell",
+    "os.popen": "blocks the loop on a shell",
+    "urllib.request.urlopen": "sync HTTP on the event loop — use aiohttp or "
+                              "`asyncio.to_thread`",
+    "requests.get": "sync HTTP on the event loop — use aiohttp",
+    "requests.post": "sync HTTP on the event loop — use aiohttp",
+    "requests.put": "sync HTTP on the event loop — use aiohttp",
+    "requests.delete": "sync HTTP on the event loop — use aiohttp",
+    "requests.head": "sync HTTP on the event loop — use aiohttp",
+    "requests.request": "sync HTTP on the event loop — use aiohttp",
+    "socket.create_connection": "sync connect on the event loop — use "
+                                "`asyncio.open_connection`",
+}
+
+_PATH_IO_METHODS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+
+
+class AsyncBlockRule:
+    id = "ASYNCBLOCK"
+    description = "blocking call inside async def"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for call in iter_calls(node.body):
+                yield from self._check_call(ctx, call)
+
+    def _check_call(self, ctx: ModuleContext, call: ast.Call) -> Iterator[Finding]:
+        name = dotted_name(call.func)
+        hint = _BLOCKING_CALLS.get(name)
+        if hint:
+            yield ctx.finding(self.id, call, f"{name}() in async def: {hint}")
+            return
+        if name == "open":
+            yield ctx.finding(
+                self.id, call,
+                "unguarded file IO in async def blocks the loop on disk "
+                "latency — wrap in `asyncio.to_thread` / run_in_executor",
+            )
+            return
+        # pathlib-style IO is the same blocking syscall as open(); an AWAITED
+        # call is an async API (anyio.Path) and exempt
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _PATH_IO_METHODS
+                and not isinstance(ctx.parent(call), ast.Await)):
+            yield ctx.finding(
+                self.id, call,
+                f".{call.func.attr}() in async def blocks the loop on disk "
+                "latency — `await asyncio.to_thread(p."
+                f"{call.func.attr})` instead",
+            )
+            return
+        # concurrent.futures-style blocking wait; asyncio.Task.result() on a
+        # task known done is the benign case → suppress at the call site
+        if (isinstance(call.func, ast.Attribute) and call.func.attr == "result"
+                and not call.args and not call.keywords):
+            yield ctx.finding(
+                self.id, call,
+                ".result() in async def blocks until the future resolves — "
+                "`await` it (or suppress when the task is provably done)",
+            )

@@ -1,0 +1,175 @@
+"""HOTSYNC: implicit device→host materialization on hot-path modules.
+
+Every pattern here forces the host to block on the device (or re-upload),
+which is exactly what the overlapped decode pipeline exists to avoid.  The
+rule runs only on modules tagged hot-path in :class:`LintConfig.hot_paths`
+(scheduler / runner / sampling / ops) — elsewhere a blocking fetch is just
+normal host code.
+
+Checks:
+
+- ``x.item()`` — per-element device fetch, the canonical silent sync;
+- bare single-argument ``np.asarray(x)`` / ``np.array(x)`` /
+  ``np.ascontiguousarray(x)`` — on a ``jax.Array`` this is an implicit
+  blocking fetch.  An INTENDED fetch should be ``jax.device_get`` (explicit,
+  and what the runtime transfer guard permits); host-only numpy conversions
+  should carry a dtype argument or a suppression;
+- ``int()/float()/bool()`` over a subscript — ``int(toks[i])`` materializes
+  one element per call;
+- device-value truthiness / iteration / print — tracked by a small
+  per-function dataflow: names assigned from ``jnp.* / jax.lax.* /
+  jax.random.* / jax.nn.*`` calls are device values, and ``if x:``,
+  ``for t in x:``, ``print(x)``, ``int(x)`` on them sync;
+- any ``print(...)`` in a hot module (stdout in the step loop is a stall
+  even when the payload is host data).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext, dotted_name
+
+_NP_MATERIALIZE = {
+    "np.asarray", "np.array", "np.ascontiguousarray",
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+}
+_DEVICE_PRODUCER_PREFIXES = (
+    "jnp.", "jax.numpy.", "jax.lax.", "lax.", "jax.random.", "jax.nn.",
+)
+_SCALARIZERS = {"int", "float", "bool"}
+
+
+def _is_device_producer(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    if name in ("jax.device_get", "jax.device_put"):
+        return False  # explicit transfers are the sanctioned escape hatch
+    return name.startswith(_DEVICE_PRODUCER_PREFIXES)
+
+
+def _device_names(fn: ast.AST) -> set[str]:
+    """Names bound (directly or via tuple unpack) from device-producing
+    calls within one function body — a deliberately shallow dataflow: one
+    hop is enough to catch ``logits = jnp.where(...)`` ... ``if logits:``."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call) and _is_device_producer(value)):
+            continue
+        for target in node.targets:
+            targets = target.elts if isinstance(target, ast.Tuple) else [target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+class HotSyncRule:
+    id = "HOTSYNC"
+    description = "implicit device→host sync on a hot-path module"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.in_hot_path():
+            return
+        # per-function device-name sets, keyed by the function node
+        device_of: dict[int, set[str]] = {}
+
+        def dev_names(node: ast.AST) -> set[str]:
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                return set()
+            if id(fn) not in device_of:
+                device_of[id(fn)] = _device_names(fn)
+            return device_of[id(fn)]
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node, dev_names(node))
+            elif isinstance(node, (ast.If, ast.While)):
+                yield from self._check_truthiness(ctx, node.test, dev_names(node))
+            elif isinstance(node, ast.Assert):
+                yield from self._check_truthiness(ctx, node.test, dev_names(node))
+            elif isinstance(node, ast.For):
+                if (isinstance(node.iter, ast.Name)
+                        and node.iter.id in dev_names(node)):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"iterating device value '{node.iter.id}' fetches one "
+                        "element per step — jax.device_get it first",
+                    )
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    if (isinstance(gen.iter, ast.Name)
+                            and gen.iter.id in dev_names(node)):
+                        yield ctx.finding(
+                            self.id, gen.iter,
+                            f"iterating device value '{gen.iter.id}' fetches "
+                            "one element per step — jax.device_get it first",
+                        )
+
+    def _check_call(
+        self, ctx: ModuleContext, call: ast.Call, device: set[str]
+    ) -> Iterator[Finding]:
+        func = call.func
+        name = dotted_name(func)
+        if (isinstance(func, ast.Attribute) and func.attr == "item"
+                and not call.args and not call.keywords):
+            yield ctx.finding(
+                self.id, call,
+                ".item() blocks on the device for one scalar — keep values "
+                "device-resident or batch the fetch with jax.device_get",
+            )
+            return
+        if name in _NP_MATERIALIZE and len(call.args) == 1 and not call.keywords:
+            yield ctx.finding(
+                self.id, call,
+                f"bare {name}(x) materializes a potential jax.Array "
+                "implicitly — use jax.device_get for an intended fetch, or "
+                "pass a dtype / suppress for host-only numpy data",
+            )
+            return
+        if name == "print":
+            yield ctx.finding(
+                self.id, call,
+                "print() in a hot-path module stalls the step loop (and "
+                "syncs any device value it formats) — use the module logger "
+                "outside the steady state",
+            )
+            return
+        if name in _SCALARIZERS and len(call.args) == 1:
+            arg = call.args[0]
+            if isinstance(arg, ast.Subscript) or (
+                isinstance(arg, ast.Name) and arg.id in device
+            ):
+                what = ast.unparse(arg) if hasattr(ast, "unparse") else "x"
+                yield ctx.finding(
+                    self.id, call,
+                    f"{name}({what}) scalarizes a potential device value — "
+                    "one blocking fetch per element; jax.device_get the "
+                    "whole array first",
+                )
+
+    def _check_truthiness(
+        self, ctx: ModuleContext, test: ast.AST, device: set[str]
+    ) -> Iterator[Finding]:
+        # `if x:` / `while x:` / `assert x` / `not x` / `x and y` on a
+        # device value calls __bool__ → blocking scalar fetch
+        exprs = [test]
+        while exprs:
+            e = exprs.pop()
+            if isinstance(e, ast.BoolOp):
+                exprs.extend(e.values)
+            elif isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.Not):
+                exprs.append(e.operand)
+            elif isinstance(e, ast.Name) and e.id in device:
+                yield ctx.finding(
+                    self.id, e,
+                    f"truth test on device value '{e.id}' is an implicit "
+                    "blocking sync — compare host-side state instead",
+                )

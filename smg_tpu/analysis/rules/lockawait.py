@@ -1,0 +1,174 @@
+"""LOCKAWAIT: lock kind vs execution domain.
+
+The codebase deliberately mixes ``threading.Lock`` (engine, registries,
+worker pools) and ``asyncio.Lock`` (connection serialization, workflow
+state) across 15+ modules; the hazards are at the seams:
+
+- a ``threading.Lock`` held across an ``await`` parks the lock while the
+  coroutine is suspended — any OTHER coroutine on the same loop that then
+  tries to take it deadlocks the loop (nobody can run to release it);
+- an ``asyncio.Lock`` entered from sync code (``with`` instead of
+  ``async with``) raises at runtime — but only on the path that hits it;
+- ``async with`` on a ``threading.Lock`` likewise fails only when reached;
+- a bare ``.acquire()`` on a threading lock inside ``async def`` blocks the
+  whole loop whenever the lock is contended.
+
+Lock kinds are inferred per class from ``self.X = threading.Lock()`` /
+``asyncio.Lock()`` assignments (plus module-level ``X = ...Lock()``), so the
+rule needs no type checker and zero annotations.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    contains_await,
+    dotted_name,
+)
+
+_THREAD_LOCKS = {
+    "threading.Lock", "threading.RLock", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Condition",
+}
+_ASYNC_LOCKS = {
+    "asyncio.Lock", "asyncio.Semaphore", "asyncio.BoundedSemaphore",
+    "asyncio.Condition",
+}
+
+
+def _lock_kind(value: ast.AST) -> str | None:
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name in _THREAD_LOCKS:
+            return "thread"
+        if name in _ASYNC_LOCKS:
+            return "async"
+    return None
+
+
+class LockAwaitRule:
+    id = "LOCKAWAIT"
+    description = "sync/async lock used from the wrong execution domain"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_kinds: dict[str, str] = {}  # bare NAME -> kind
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _lock_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_kinds[t.id] = kind
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node, module_kinds)
+        # module-level / free functions using module-level locks
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_scope(ctx, node, {}, module_kinds)
+
+    def _check_class(
+        self, ctx: ModuleContext, cls: ast.ClassDef, module_kinds: dict[str, str]
+    ) -> Iterator[Finding]:
+        attr_kinds: dict[str, str] = {}  # self.X -> kind
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                kind = _lock_kind(node.value)
+                if not kind:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        attr_kinds[t.attr] = kind
+        if not attr_kinds and not module_kinds:
+            return
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_scope(ctx, node, attr_kinds, module_kinds)
+
+    def _kind_of(
+        self, expr: ast.AST, attr_kinds: dict[str, str],
+        module_kinds: dict[str, str],
+    ) -> tuple[str, str] | None:
+        """(kind, display-name) when ``expr`` is a known lock reference."""
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and expr.attr in attr_kinds):
+            return attr_kinds[expr.attr], f"self.{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in module_kinds:
+            return module_kinds[expr.id], expr.id
+        return None
+
+    def _check_scope(
+        self, ctx: ModuleContext, fn, attr_kinds: dict[str, str],
+        module_kinds: dict[str, str],
+    ) -> Iterator[Finding]:
+        """One function scope, judged by its OWN async-ness.  Nested defs run
+        on their own call (a sync helper handed to asyncio.to_thread is
+        off-loop; a nested coroutine is on-loop regardless of its factory),
+        so each recurses with its own flag instead of inheriting this one."""
+        is_async = isinstance(fn, ast.AsyncFunctionDef)
+        nested: list = []
+        stack: list[ast.AST] = list(fn.body)
+        scope_nodes: list[ast.AST] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(n)
+                continue
+            if isinstance(n, ast.Lambda):
+                continue
+            scope_nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for node in scope_nodes:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    known = self._kind_of(
+                        item.context_expr, attr_kinds, module_kinds
+                    )
+                    if known is None:
+                        continue
+                    kind, disp = known
+                    if isinstance(node, ast.AsyncWith) and kind == "thread":
+                        yield ctx.finding(
+                            self.id, node,
+                            f"`async with {disp}` on a threading lock — not "
+                            "an async context manager; use asyncio.Lock or a "
+                            "plain `with` (without awaits inside)",
+                        )
+                    elif isinstance(node, ast.With):
+                        if kind == "async":
+                            yield ctx.finding(
+                                self.id, node,
+                                f"`with {disp}` on an asyncio lock from sync "
+                                "code raises at runtime — use `async with` "
+                                "from a coroutine",
+                            )
+                        elif kind == "thread" and is_async:
+                            site = contains_await(node.body)
+                            if site is not None:
+                                yield ctx.finding(
+                                    self.id, node,
+                                    f"threading lock {disp} held across "
+                                    f"`await` (line {site.lineno}): a second "
+                                    "coroutine taking it deadlocks the event "
+                                    "loop — narrow the critical section or "
+                                    "switch to asyncio.Lock",
+                                )
+            elif (is_async and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                known = self._kind_of(node.func.value, attr_kinds, module_kinds)
+                if known and known[0] == "thread":
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{known[1]}.acquire() inside async def blocks the "
+                        "event loop when contended — use asyncio.Lock or "
+                        "move the critical section off-loop",
+                    )
+        for sub in nested:
+            yield from self._check_scope(ctx, sub, attr_kinds, module_kinds)

@@ -1,0 +1,232 @@
+"""RETRACE: ``jax.jit`` hazards that silently recompile per call.
+
+A retrace doesn't crash — it shows up as a multi-hundred-ms stall in the
+middle of steady-state decode, which is why the runtime recompile guard
+(``analysis.runtime_guards.CompileCounter``) pairs with this rule.  The
+static side catches the construction-site shapes that cause it:
+
+- jit construction inside a ``for``/``while`` loop: a fresh wrapper (and
+  fresh compile cache) every iteration;
+- jit construction inside a function body with no memoization evidence: if
+  the function runs per step, every call builds a new wrapper.  Evidence
+  accepted: an ``in``-membership test anywhere in the function (the
+  ``if key in self._compiled: return ...`` idiom used throughout
+  ``engine/runner.py``) or an ``lru_cache``/``cache`` decorator;
+- a jitted local closure capturing the enclosing function's loop variable:
+  per-request Python scalars baked into the trace, one compile per value;
+- an immediately-invoked jit with a list/dict/set literal in a
+  ``static_argnums`` position: unhashable static → TypeError at best,
+  per-call retrace via workaround hashing at worst.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext, dotted_name
+
+_JIT_NAMES = {"jax.jit", "jax.pmap", "pjit.pjit", "jax.experimental.pjit.pjit"}
+_MEMO_DECORATORS = {
+    "lru_cache", "cache", "cached_property",
+    "functools.lru_cache", "functools.cache", "functools.cached_property",
+}
+
+
+def _is_jit_call(call: ast.Call, jit_aliases: set[str]) -> bool:
+    name = dotted_name(call.func)
+    return name in _JIT_NAMES or name in jit_aliases
+
+
+def _jit_aliases(tree: ast.Module) -> set[str]:
+    """Bare names that refer to jax.jit/pmap via ``from jax import jit``."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax", "jax.experimental.pjit"
+        ):
+            for a in node.names:
+                if a.name in ("jit", "pmap", "pjit"):
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _has_memo_evidence(fn: ast.AST) -> bool:
+    """Accepted shapes: lru_cache/cache decorators, the ``if key in
+    self._compiled`` membership idiom, or a ``X.get(...)`` lookup (the
+    dict-as-LRU idiom in ``engine.Engine._run_vision``).  Heuristic by
+    design — a function that probes a cache and still constructs jit per
+    call slips through, which the runtime CompileCounter then catches."""
+    for deco in getattr(fn, "decorator_list", []):
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if dotted_name(target) in _MEMO_DECORATORS:
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+        ):
+            return True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args):
+            return True
+    return False
+
+
+def _free_names(fn: ast.FunctionDef | ast.Lambda) -> set[str]:
+    """Names a local function reads but never binds — closure captures."""
+    bound = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    loaded: set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack: list[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load):
+                loaded.add(n.id)
+            else:
+                bound.add(n.id)
+        stack.extend(ast.iter_child_nodes(n))
+    return loaded - bound
+
+
+class RetraceRule:
+    id = "RETRACE"
+    description = "jax.jit construction pattern that retraces per call"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        aliases = _jit_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(node, aliases):
+                yield from self._check_jit_site(ctx, node)
+
+    def _check_jit_site(
+        self, ctx: ModuleContext, call: ast.Call
+    ) -> Iterator[Finding]:
+        enclosing_fn = None
+        in_loop = False
+        for a in ctx.ancestors(call):
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+                in_loop = True
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                enclosing_fn = a
+                break
+        memo_scope = enclosing_fn if enclosing_fn is not None else ctx.tree
+        memoized = _has_memo_evidence(memo_scope) or self._is_lazy_init(ctx, call)
+        if in_loop and not memoized:
+            # a memoized loop (`if k in cache: continue; cache[k] = jit(...)`)
+            # constructs once per key — bounded variants, the runner-bucket
+            # pattern — so only the unguarded form fires here
+            yield ctx.finding(
+                self.id, call,
+                "jax.jit constructed inside a loop: a fresh wrapper (and "
+                "compile cache) every iteration — hoist the jit out and "
+                "reuse it",
+            )
+            return
+        if enclosing_fn is not None and not memoized:
+            yield ctx.finding(
+                self.id, call,
+                "jax.jit constructed in a function body with no memoization "
+                "(no cache-membership test or lru_cache): a per-step caller "
+                "recompiles every call — cache the wrapper like "
+                "runner._compiled does",
+            )
+        if enclosing_fn is not None:
+            # fires even under memoization: a captured loop variable means
+            # one compile per VALUE, which a key'd cache makes unbounded
+            # unless the key is exactly that value — worth a look either way
+            yield from self._check_loop_capture(ctx, call, enclosing_fn)
+        yield from self._check_static_args(ctx, call)
+
+    def _is_lazy_init(self, ctx: ModuleContext, call: ast.Call) -> bool:
+        """True for the lazy-init idiom: the jit result is assigned to the
+        very name/attribute that an enclosing ``if X is None:`` tested, so
+        construction happens once, not per call::
+
+            if self._fold_in is None:
+                self._fold_in = jax.jit(jax.random.fold_in)
+        """
+        assign = ctx.parent(call)
+        if not isinstance(assign, ast.Assign):
+            return False
+        for a in ctx.ancestors(call):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if not (isinstance(a, ast.If) and isinstance(a.test, ast.Compare)):
+                continue
+            if not (
+                any(isinstance(op, ast.Is) for op in a.test.ops)
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in a.test.comparators)
+            ):
+                continue
+            tested = a.test.left
+            for t in assign.targets:
+                if ast.unparse(t) == ast.unparse(tested):
+                    return True
+        return False
+
+    def _check_loop_capture(
+        self, ctx: ModuleContext, call: ast.Call, enclosing_fn
+    ) -> Iterator[Finding]:
+        if not call.args:
+            return
+        target = call.args[0]
+        local_fn = None
+        if isinstance(target, ast.Lambda):
+            local_fn = target
+        elif isinstance(target, ast.Name):
+            for node in ast.walk(enclosing_fn):
+                if isinstance(node, ast.FunctionDef) and node.name == target.id:
+                    local_fn = node
+                    break
+        if local_fn is None:
+            return
+        loop_targets: set[str] = set()
+        for node in ast.walk(enclosing_fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                t = node.target
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                loop_targets.update(
+                    e.id for e in elts if isinstance(e, ast.Name)
+                )
+        captured = _free_names(local_fn) & loop_targets
+        if captured:
+            yield ctx.finding(
+                self.id, call,
+                f"jitted closure captures loop variable(s) "
+                f"{sorted(captured)}: each value bakes into the trace as a "
+                "Python constant — one compile per value; pass them as "
+                "array arguments instead",
+            )
+
+    def _check_static_args(
+        self, ctx: ModuleContext, call: ast.Call
+    ) -> Iterator[Finding]:
+        static_positions: list[int] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                v = kw.value
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        static_positions.append(e.value)
+        if not static_positions:
+            return
+        outer = ctx.parent(call)
+        if not (isinstance(outer, ast.Call) and outer.func is call):
+            return  # not the immediately-invoked form; call sites untracked
+        for pos in static_positions:
+            if pos < len(outer.args) and isinstance(
+                outer.args[pos], (ast.List, ast.Dict, ast.Set)
+            ):
+                yield ctx.finding(
+                    self.id, outer,
+                    f"unhashable literal passed in static_argnums position "
+                    f"{pos}: static args are dict keys in the compile cache "
+                    "— pass a tuple (or make the arg traced)",
+                )

@@ -1,0 +1,114 @@
+"""Runtime complements to the static rules: transfer + recompile guards.
+
+Static analysis catches the patterns; these guards catch the *effects* on
+the real engine, wired into ``tests/test_analysis.py`` and the
+``benches/bench_engine.py`` steady-state probe:
+
+- :func:`no_implicit_transfers` — ``jax.transfer_guard("disallow")`` around
+  the steady-state decode section.  The hot path performs its intended
+  transfers explicitly (``jax.device_put`` uploads in
+  ``runner.decode_multi_async``, ``jax.device_get`` fetches in
+  ``scheduler._consume_frame``), so under the guard any IMPLICIT transfer —
+  a stray ``.item()``, a numpy scalar leaking into device math, a host
+  array hitting a jit boundary — raises instead of silently stalling the
+  pipeline;
+- :class:`CompileCounter` — counts XLA backend compiles via
+  ``jax.monitoring``.  After warmup, steady-state decode must compile
+  nothing: a nonzero count is a retrace regression even when throughput
+  noise hides the stall.
+
+jax is imported lazily so the lint-only CLI stays jax-free.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+# every XLA backend compile records this event (jax>=0.4 monitoring)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_compile_count = 0
+_listener_installed = False
+
+
+def _on_event(name: str, *_args, **_kw) -> None:
+    global _compile_count
+    if _COMPILE_EVENT in name:
+        _compile_count += 1
+
+
+def _ensure_listener() -> None:
+    """Install the monitoring listener once per process.  jax.monitoring has
+    no unregister API short of clearing ALL listeners, so the module keeps a
+    single monotonic counter and :class:`CompileCounter` instances snapshot
+    it."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+    _listener_installed = True
+
+
+def compile_count() -> int:
+    """Monotonic count of XLA backend compiles observed so far (0 until the
+    first guard/counter installs the listener)."""
+    return _compile_count
+
+
+class CompileCounter:
+    """Context manager counting XLA compiles inside the ``with`` block::
+
+        with CompileCounter() as cc:
+            engine.step()
+        assert cc.count == 0, "steady-state decode recompiled"
+    """
+
+    def __init__(self) -> None:
+        self._start = 0
+        self.count = 0
+
+    def __enter__(self) -> "CompileCounter":
+        _ensure_listener()
+        self._start = _compile_count
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.count = _compile_count - self._start
+
+
+@contextmanager
+def no_implicit_transfers():
+    """Raise on any implicit host↔device transfer inside the block.
+
+    Explicit ``jax.device_put`` / ``jax.device_get`` — the forms the hot
+    path uses for its intended per-step traffic — stay allowed, so this is
+    precisely "no transfer the code didn't ask for by name"."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextmanager
+def steady_state_guard(max_compiles: int = 0):
+    """Both guards at once, for wrapping post-warmup decode steps::
+
+        with steady_state_guard() as cc:
+            for _ in range(8):
+                engine.step()
+
+    Raises RuntimeError when the block compiled more than ``max_compiles``
+    XLA programs; implicit transfers raise from inside jax at the offending
+    call (with a stack trace pointing at the violator — better than any
+    after-the-fact count)."""
+    with no_implicit_transfers():
+        with CompileCounter() as cc:
+            yield cc
+    if cc.count > max_compiles:
+        raise RuntimeError(
+            f"steady-state section compiled {cc.count} XLA program(s) "
+            f"(budget {max_compiles}): a jit signature changed per step — "
+            "see the RETRACE rule docs in smg_tpu/analysis/rules/retrace.py"
+        )

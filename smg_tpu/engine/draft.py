@@ -58,6 +58,7 @@ class DraftRunner:
             model_cfg.head_dim, model_cfg.rope_theta, model_cfg.rope_scaling
         ))
         if params is None:
+            # smglint: disable-next=RETRACE one-shot weight init at construction
             params = jax.jit(partial(self.module.init_params, model_cfg))(
                 jax.random.PRNGKey(seed)
             )

@@ -113,6 +113,7 @@ class Engine:
                 from smg_tpu.models.vit import init_vision_params
 
                 vkey = jax.random.PRNGKey(config.seed ^ 0x71510)
+                # smglint: disable-next=RETRACE one-shot vision-tower init
                 self._vision_params = jax.jit(
                     lambda k: init_vision_params(config.model.vision, k)
                 )(vkey)

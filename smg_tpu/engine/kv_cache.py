@@ -114,6 +114,7 @@ def create_kv_buffers(spec: KvCacheSpec, sharding=None) -> tuple[jax.Array, jax.
     shape = spec.shape
     dtype = jnp.dtype(spec.dtype)
     if sharding is not None:
+        # smglint: disable-next=RETRACE runs at engine init / idle flush_cache only
         zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=(sharding))
         k = zeros()
         v = zeros()

@@ -36,7 +36,10 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
+import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from smg_tpu.engine.config import EngineConfig
 from smg_tpu.engine.kv_cache import PagePool
@@ -305,7 +308,11 @@ class Scheduler:
             # schedule.  Their KV overshoot past each request's final seq_len
             # never enters the radix cache, so dropping them is safe.
             self._discard_frame(frame)
-            outcome = "discarded"
+            # only a LOOKAHEAD discard counts toward the kept/discarded
+            # metric ratio — a stale cold frame dropped on stop/abort is not
+            # a lookahead outcome (same rule _discard_frame applies to
+            # loads()' counters; the two surfaces must agree)
+            outcome = "discarded" if frame.lookahead else "sync"
             frame = None
         if frame is not None:
             # launch the NEXT decode chained on the in-flight one BEFORE
@@ -397,10 +404,11 @@ class Scheduler:
         self, frame: InFlightFrame, outputs: list[StepOutput]
     ) -> float:
         """Deferred fetch + host-side acceptance; returns seconds blocked on
-        the device (np.asarray materializes the async results)."""
+        the device.  ``jax.device_get`` is the EXPLICIT materialization of
+        the async results — the one intended device→host sync per steady
+        -state step, and the form the transfer guard permits."""
         t0 = time.perf_counter()
-        toks = np.asarray(frame.toks)
-        lps = np.asarray(frame.lps)
+        toks, lps = jax.device_get((frame.toks, frame.lps))
         fetch_s = time.perf_counter() - t0
         if frame.lookahead:
             self.num_lookahead_kept += 1
@@ -465,8 +473,14 @@ class Scheduler:
             frame.use_pen, frame.use_lora, frame.use_mrope, frame.lane_sig,
         )
         mark = self.runner.rng_mark()
+        # the chained input column comes off the in-flight frame with a
+        # STATIC lax slice: `frame.toks[:, -1]` would route the index through
+        # eager dispatch as a scalar operand — an implicit host→device
+        # transfer every launch, which the steady-state guard forbids
+        last_col = lax.index_in_dim(frame.toks, frame.horizon - 1, axis=1,
+                                    keepdims=False)
         toks, lps = self.runner.decode_multi_async(
-            frame.toks[:, -1], positions, ds.page_tables,
+            last_col, positions, ds.page_tables,
             ds.temps, ds.topks, ds.topps, ds.minps, H,
             pen=(ds.slot_idx, ds.freqs, ds.pres, ds.reps)
             if frame.use_pen else None,
@@ -686,10 +700,12 @@ class Scheduler:
             if sel.size == 0 and mr is None:
                 continue
             h = hashlib.blake2b(digest_size=8)
+            # smglint: disable-next=HOTSYNC mm positions/embeds are host numpy
             h.update(np.ascontiguousarray(positions[sel] - lo).tobytes())
             h.update(np.ascontiguousarray(embeds[sel], np.float32).tobytes())
             if mr is not None:
                 h.update(b"mrope")
+                # smglint: disable-next=HOTSYNC mrope ids are host numpy
                 h.update(np.ascontiguousarray(mr).tobytes())
             keys[p] = int.from_bytes(h.digest(), "little") or 1
         req.mm_extra_keys = (n_tokens, keys)
@@ -762,6 +778,7 @@ class Scheduler:
         for i, req in enumerate(group):
             req.seq_len = req.total_len
             self._accept_tokens(
+                # smglint: disable-next=HOTSYNC toks/lps fetched in prefill_batched
                 req, [int(toks[i])], [float(lps[i])], outputs, advance_seq=False
             )
 
@@ -802,8 +819,6 @@ class Scheduler:
         mutation (``_pages_dirty``).  Steady-state decode therefore re-uses
         resident ``jax.Array``s — ``jnp.asarray`` in the runner is a no-op —
         instead of ~10 host->device uploads per step."""
-        import jax.numpy as jnp
-
         ds = self._dstate
         S = self.sched.max_batch_size  # runner's garbage penalty-state row
         if ds.lane_sig != sig:

@@ -61,13 +61,22 @@ class KubeApi:
     async def list_pods(self, selector: str) -> list[dict]:
         import aiohttp
 
-        if self._token is None:
-            with open("/var/run/secrets/kubernetes.io/serviceaccount/token") as f:
-                self._token = f.read().strip()
+        # session init stays BEFORE the first await: the check-then-create
+        # must run in one synchronous segment, or two concurrent first calls
+        # would both construct a ClientSession and leak one
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 connector=aiohttp.TCPConnector(ssl=False)
             )
+        if self._token is None:
+            # serviceaccount token read rides a worker thread: list_pods runs
+            # on the gateway loop during discovery refresh, and a slow kubelet
+            # volume mount must not stall in-flight streams (ASYNCBLOCK)
+            def _read_token() -> str:
+                with open("/var/run/secrets/kubernetes.io/serviceaccount/token") as f:
+                    return f.read().strip()
+
+            self._token = await asyncio.to_thread(_read_token)
         url = (
             f"https://{self.host}:{self.port}/api/v1/namespaces/"
             f"{self.namespace}/pods?labelSelector={selector}"

@@ -262,15 +262,19 @@ async def _run_gateway(args) -> int:
     )
     if getattr(args, "mcp_config_path", None):
         import json as _json
+        from pathlib import Path as _Path
 
         from smg_tpu.mcp import HttpMcpServer
 
-        with open(args.mcp_config_path) as f:
-            for spec in _json.load(f):
-                ctx.mcp.add(HttpMcpServer(
-                    name=spec.get("name", spec["url"]), url=spec["url"],
-                    headers=spec.get("headers"),
-                ))
+        # startup runs on the serving loop already (aiohttp runner): config
+        # reads go through a thread so a cold NFS/volume mount can't wedge
+        # signal handling or health probes registered before this point
+        raw = await asyncio.to_thread(_Path(args.mcp_config_path).read_text)
+        for spec in _json.loads(raw):
+            ctx.mcp.add(HttpMcpServer(
+                name=spec.get("name", spec["url"]), url=spec["url"],
+                headers=spec.get("headers"),
+            ))
     if getattr(args, "provider_config", None):
         ctx.providers.load_config(args.provider_config)
     if getattr(args, "mm_transport", None):

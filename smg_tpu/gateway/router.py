@@ -915,6 +915,9 @@ class Router:
                     (e for e in eg.exceptions if isinstance(e, RouteError)), None
                 )
                 raise route if route is not None else eg.exceptions[0]
+            # TaskGroup exit guarantees every task is done: result() here is
+            # a non-blocking unwrap, not a futures wait
+            # smglint: disable-next=ASYNCBLOCK tasks are done after TaskGroup exit
             results = [t.result() for t in tasks]
         else:
             tasks = [asyncio.ensure_future(run_one(i)) for i in range(sampling.n)]
@@ -936,6 +939,9 @@ class Router:
                 await asyncio.gather(*tasks, return_exceptions=True)
                 route = next((e for e in errors if isinstance(e, RouteError)), None)
                 raise route if route is not None else errors[0]
+            # asyncio.wait(FIRST_EXCEPTION) returned with no errors -> every
+            # task completed; result() is a non-blocking unwrap
+            # smglint: disable-next=ASYNCBLOCK tasks are done after asyncio.wait
             results = [t.result() for t in tasks]
         choices = [c for c, _ in results]
         usage = UsageInfo(
