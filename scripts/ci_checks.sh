@@ -7,7 +7,12 @@
 #   2. metric docs    — README observability table vs exported smg_* series;
 #   3. runtime guards — transfer-guard + zero-recompile probes on the real
 #                       engine's steady-state decode loop (the runtime teeth
-#                       behind HOTSYNC/RETRACE), via tests/test_analysis.py.
+#                       behind HOTSYNC/RETRACE), via tests/test_analysis.py;
+#   4. chunked-prefill scheduling — budgeted-vs-legacy and overlap/sync
+#                       stream parity under the per-step prefill budget,
+#                       plus mid-prefill preemption/abort lifecycle
+#                       (tests/test_chunked_prefill.py + the chunked cases
+#                       in tests/test_overlap.py).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -22,5 +27,9 @@ JAX_PLATFORMS=cpu python scripts/check_metric_docs.py
 echo "== lint rule suite + runtime guard probes =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
     -p no:cacheprovider
+
+echo "== chunked-prefill scheduling parity =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_chunked_prefill.py \
+    tests/test_overlap.py -q -m 'not slow' -p no:cacheprovider
 
 echo "ci_checks: all green"

@@ -220,6 +220,18 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--kv-dtype", default=None, dest="kv_dtype",
                    choices=["bfloat16", "float32", "float16"],
                    help="KV cache dtype (default: follow --dtype)")
+    g.add_argument("--max-prefill-tokens", type=int, default=4096,
+                   dest="max_prefill_tokens",
+                   help="per-STEP prefill token budget (stall-free chunked "
+                        "prefill): each scheduler step spends at most this "
+                        "many prompt tokens on prefill")
+    g.add_argument("--prefill-mix-policy", default="stall-free",
+                   dest="prefill_mix_policy",
+                   choices=["stall-free", "throughput"],
+                   help="prefill scheduling: 'stall-free' meters prefill to "
+                        "the per-step budget (resumable chunks; decode runs "
+                        "every step), 'throughput' drains the waiting queue "
+                        "per step (legacy; long prompts stall decode)")
     g.add_argument("--speculative", action="store_true",
                    help="speculative decoding: n-gram prompt-lookup drafts "
                         "(or a draft model via --draft-model-path); greedy "

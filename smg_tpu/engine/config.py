@@ -71,7 +71,12 @@ class SchedulerConfig:
 
     max_batch_size: int = 64  # decode slots
     max_seq_len: int = 8192
-    max_prefill_tokens: int = 4096  # per prefill step (chunked prefill budget)
+    # per-STEP prefill token budget (Sarathi-style stall-free chunked
+    # prefill): each step() spends at most this many prompt tokens on
+    # prefill — split across a group of short prompts or one chunk of a long
+    # one — and decode runs every step, so running lanes never observe a
+    # multi-chunk stall while a long prompt streams in
+    max_prefill_tokens: int = 4096
     prefill_token_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
     decode_batch_buckets: tuple[int, ...] = (8, 16, 32, 64)
     schedule_policy: str = "fcfs"  # fcfs | priority
@@ -85,6 +90,17 @@ class SchedulerConfig:
     # single-chunk prompts admitted together in one batched prefill call
     # (fills the MXU and amortizes dispatch; long prompts still chunk solo)
     max_prefill_group: int = 8
+    # prefill scheduling policy:
+    #   "stall-free"  — max_prefill_tokens is a true per-step budget:
+    #                   admission is capped per step, long prompts advance
+    #                   one resumable chunk per step (PREFILLING cursor),
+    #                   leftover budget packs partial chunks of the next
+    #                   waiting prompt, and decode runs EVERY step;
+    #   "throughput"  — legacy drain-the-queue admission: all chunks of a
+    #                   long prompt run back-to-back inside one step and the
+    #                   waiting queue drains before decode (maximizes prefill
+    #                   throughput, stalls decode ITL under long prompts).
+    prefill_mix_policy: str = "stall-free"
     # overlapped decode pipeline (one-step lookahead): the step loop launches
     # the next decode before last step's outputs are consumed, so host-side
     # work (detokenize, stop strings, admission bookkeeping) hides behind
@@ -105,6 +121,11 @@ class SchedulerConfig:
             raise ValueError("max_batch_size must be <= largest decode batch bucket")
         if self.max_prefill_tokens > max(self.prefill_token_buckets):
             raise ValueError("max_prefill_tokens must be <= largest prefill bucket")
+        if self.prefill_mix_policy not in ("stall-free", "throughput"):
+            raise ValueError(
+                "prefill_mix_policy must be 'stall-free' or 'throughput', "
+                f"got {self.prefill_mix_policy!r}"
+            )
 
     def prefill_bucket(self, n_tokens: int) -> int:
         for b in self.prefill_token_buckets:

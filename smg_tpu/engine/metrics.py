@@ -214,6 +214,28 @@ class EngineMetrics:
             "Device memory capacity (device.memory_stats; absent on CPU)",
             ["device"], registry=r,
         ))
+        # stall-free chunked-prefill scheduling (per-step prefill budget)
+        self.steps_kind = _track(Counter(
+            "smg_engine_steps_total",
+            "Scheduler steps that moved tokens, by composition (kind: "
+            "prefill-only, decode-only, or mixed — a mixed step carried a "
+            "prefill chunk AND a decode launch under the per-step budget)",
+            ["kind"], registry=r,
+        ))
+        self.decode_stall = _track(Counter(
+            "smg_engine_decode_stall_seconds_total",
+            "Decode delay attributable to same-step prefill work (host-side "
+            "prefill-phase seconds in steps that also decoded); bounded by "
+            "~one chunk per step under stall-free scheduling, by the whole "
+            "prompt under the legacy throughput policy",
+            registry=r,
+        ))
+        self.prefill_inflight = _track(Gauge(
+            "smg_engine_prefill_inflight_tokens",
+            "Un-prefilled prompt tokens of admitted in-progress (resumable) "
+            "prefills — slot-holding prefill backlog",
+            registry=r,
+        ))
         # overlapped decode pipeline (scheduler one-step lookahead)
         self.lookahead_launches = _track(Counter(
             "smg_engine_lookahead_launches_total",
@@ -292,6 +314,7 @@ class EngineMetrics:
         running: int,
         waiting: int,
         max_batch: int,
+        prefill_inflight_tokens: int = 0,
         free_pages: int,
         total_pages: int,
         cached_pages: int,
@@ -308,6 +331,16 @@ class EngineMetrics:
         if decode_tokens:
             self.step_duration.labels(phase="decode").observe(decode_s)
             self.decode_tokens.inc(decode_tokens)
+        if prefill_tokens or decode_tokens:
+            kind = (
+                "mixed" if (prefill_tokens and decode_tokens)
+                else ("prefill" if prefill_tokens else "decode")
+            )
+            self.steps_kind.labels(kind=kind).inc()
+            if prefill_tokens and decode_tokens:
+                # the decode launch waited behind this step's prefill work
+                self.decode_stall.inc(max(prefill_s, 0.0))
+        self.prefill_inflight.set(prefill_inflight_tokens)
         self.running_requests.set(running)
         self.waiting_requests.set(waiting)
         self.batch_occupancy.set(running / max_batch if max_batch else 0.0)

@@ -12,6 +12,9 @@ from smg_tpu.protocols.sampling import SamplingParams
 
 class RequestStatus(enum.Enum):
     WAITING = "waiting"
+    # admitted to a slot, prompt KV partially computed (resumable chunked
+    # prefill: ``prefill_pos`` is the cursor); not yet a decode lane
+    PREFILLING = "prefilling"
     RUNNING = "running"
     PREEMPTED = "preempted"
     FINISHED = "finished"
@@ -38,6 +41,10 @@ class EngineRequest:
     output_ids: list[int] = field(default_factory=list)
     logprobs: list[float] = field(default_factory=list)
     seq_len: int = 0  # tokens whose KV is currently cached
+    # resumable-prefill cursor: prompt tokens whose KV is computed so far
+    # (== seq_len while PREFILLING; chunked prefill advances it at most one
+    # per-step budget's worth per scheduler step)
+    prefill_pos: int = 0
     cached_tokens: int = 0  # tokens served from the radix prefix cache
     owned_pages: list[int] = field(default_factory=list)  # pages this request owns
     shared_pages: list[int] = field(default_factory=list)  # radix-cache pages (pinned)

@@ -535,6 +535,74 @@ class ModelRunner:
         self._compiled[k] = fn
         return fn
 
+    def _prefill_extend_fn(self, T: int, mp: int, use_lora: bool = False,
+                           use_ring: bool = False, use_embeds: bool = False,
+                           use_mrope: bool = False):
+        """KV-write-only prefill chunk: a NON-final chunk of a resumable
+        (budgeted) prefill writes prompt KV but samples nothing — the lm head
+        and sampler are absent from the program (XLA DCEs them), no sampling
+        key is folded, and nothing is fetched.  That fold-neutrality is what
+        lets the overlap pipeline keep a lookahead decode frame in flight
+        while a ``PREFILLING`` request advances: the global key-fold order
+        stays exactly the budgeted-sync order (prefill folds only on FINAL
+        chunks, which suppress the lookahead for that step)."""
+        impl = "xla" if use_ring else self._prefill_impl_for(mp)
+        k = ("prefill_extend", T, mp, impl, use_lora, use_ring, use_embeds,
+             use_mrope)
+        if k in self._compiled:
+            return self._compiled[k]
+        cfg = self.model_cfg
+        module = self.module
+        n_slots = self.lora_slots
+        sp_mesh = self.mesh if use_ring else None
+        pp_mesh = self.mesh if self.use_pp else None
+
+        def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc,
+                 page_table, *extra):
+            i = 0
+            lora_bank = lora_gates = None
+            if use_lora:
+                lora_bank, lora_idx = extra[i], extra[i + 1]
+                lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
+                i += 2
+            input_embeds = embeds_mask = None
+            if use_embeds:
+                input_embeds, embeds_mask = extra[i], extra[i + 1]
+                i += 2
+            rope_pos = extra[i] if use_mrope else None
+            _logits, kc, vc = module.forward_prefill(
+                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc,
+                page_table,
+                lora=lora_bank, lora_gates=lora_gates, sp_mesh=sp_mesh,
+                attn_impl=impl,
+                input_embeds=input_embeds, embeds_mask=embeds_mask,
+                pp_mesh=pp_mesh,
+                rope_pos=rope_pos,
+            )
+            return kc, vc
+
+        n_extra = ((2 if use_lora else 0) + (2 if use_embeds else 0)
+                   + (1 if use_mrope else 0))
+        # same CPU-PJRT caveat as decode_multi: a donated input makes CPU
+        # dispatch synchronous, and this call exists precisely to stay async
+        # under an in-flight decode frame — skip donation there
+        donate = () if self._kv_donation_blocks_dispatch() else (5, 6)
+        if self.mesh is not None:
+            r = self._replicated
+            in_sh = (self.param_shardings, r, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r)
+            in_sh = in_sh + (r,) * n_extra
+            fn = jax.jit(
+                step,
+                in_shardings=in_sh,
+                out_shardings=(self.kv_sharding, self.kv_sharding),
+                donate_argnums=donate,
+            )
+        else:
+            fn = jax.jit(step, donate_argnums=donate)
+        self._compiled[k] = fn
+        return fn
+
     def _prefill_batched_fn(self, G: int, T: int, mp: int, no_ctx: bool = False,
                             use_pen: bool = False, use_mask: bool = False,
                             use_lora: bool = False, use_embeds: bool = False,
@@ -952,6 +1020,70 @@ class ModelRunner:
 
     # ---- host-facing API ----
 
+    def _prefill_chunk_prep(
+        self, token_ids, prefix_len, page_table, lora_idx, mm, rope_pos
+    ):
+        """Shared host-side packing/validation for one prefill chunk — the
+        invariants the sampling (``prefill``) and KV-only
+        (``prefill_extend``) entry points must never diverge on.
+
+        - Bucket padding: chunk padded to the prefill token bucket.
+        - Scheduler invariant the Pallas prefill kernel relies on: every
+          chunk token's position must fit the page table (the kernel attends
+          tokens past capacity where the XLA path drops them — divergence
+          documented at ops/pallas/prefill_attention.py).  Fail loudly here
+          instead of producing path-dependent attention.
+        - Sequence-parallel prefill: cold chunks (the long-context case — a
+          huge first chunk is exactly what sp exists for) ring-attend with
+          the token dim sharded over sp; warm chunks need the cache gather.
+        Returns (T, mp, base_args, use_lora, use_ring, tail_args) where
+        ``base_args`` is the common [params..page_table] prefix and
+        ``tail_args`` the lora/mm/rope suffix in extra-arg order."""
+        t = len(token_ids)
+        T = self.config.scheduler.prefill_bucket(t)
+        tokens = np.zeros(T, np.int32)
+        tokens[:t] = token_ids
+        mp = len(page_table)
+        ps = self.config.cache.page_size
+        if prefix_len + t > mp * ps:
+            raise ValueError(
+                f"prefill chunk overruns page table: prefix {prefix_len} + "
+                f"chunk {t} > {mp} pages * {ps}"
+            )
+        use_lora = lora_idx > 0 and self._lora_bank is not None
+        sp = self.config.parallel.sp
+        use_ring = (
+            self.mesh is not None and sp > 1 and prefix_len == 0 and T % sp == 0
+            and not self.use_pp  # ring + pp composition is future work
+        )
+        if rope_pos is not None and use_ring:
+            raise ValueError("M-RoPE does not compose with ring prefill yet")
+        base_args = [
+            self.params,
+            self.inv_freq,
+            jnp.asarray(tokens),
+            jnp.int32(prefix_len),
+            jnp.int32(t),
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(page_table, jnp.int32),
+        ]
+        tail_args = []
+        if use_lora:
+            tail_args += [self._lora_bank, jnp.int32(lora_idx)]
+        if mm is not None:
+            embeds, emask = mm
+            pe = np.zeros((T, embeds.shape[1]), np.float32)
+            pe[:t] = embeds
+            pm = np.zeros(T, bool)
+            pm[:t] = emask
+            tail_args += [jnp.asarray(pe), jnp.asarray(pm)]
+        if rope_pos is not None:
+            rp = np.zeros((3, T), np.int32)
+            rp[:, :t] = rope_pos
+            tail_args.append(jnp.asarray(rp))
+        return T, mp, base_args, use_lora, use_ring, tail_args
+
     def prefill(
         self,
         token_ids: list[int],
@@ -968,46 +1100,15 @@ class ModelRunner:
         rope_pos: "np.ndarray | None" = None,  # [3, t] M-RoPE position ids
     ) -> tuple[int, float]:
         """Run one prefill chunk; returns (sampled_token, logprob)."""
-        t = len(token_ids)
-        T = self.config.scheduler.prefill_bucket(t)
-        tokens = np.zeros(T, np.int32)
-        tokens[:t] = token_ids
-        mp = len(page_table)
-        # Scheduler invariant the Pallas prefill kernel relies on: every
-        # chunk token's position must fit the page table (the kernel attends
-        # tokens past capacity where the XLA path drops them — divergence
-        # documented at ops/pallas/prefill_attention.py).  Fail loudly here
-        # instead of producing path-dependent attention.
-        ps = self.config.cache.page_size
-        if prefix_len + t > mp * ps:
-            raise ValueError(
-                f"prefill chunk overruns page table: prefix {prefix_len} + "
-                f"chunk {t} > {mp} pages * {ps}"
+        T, mp, base_args, use_lora, use_ring, tail_args = \
+            self._prefill_chunk_prep(
+                token_ids, prefix_len, page_table, lora_idx, mm, rope_pos
             )
-        use_lora = lora_idx > 0 and self._lora_bank is not None
-        # sequence-parallel prefill: cold chunks (the long-context case — a
-        # huge first chunk is exactly what sp exists for) ring-attend with the
-        # token dim sharded over sp; warm chunks need the cache gather
-        sp = self.config.parallel.sp
-        use_ring = (
-            self.mesh is not None and sp > 1 and prefix_len == 0 and T % sp == 0
-            and not self.use_pp  # ring + pp composition is future work
-        )
-        if rope_pos is not None and use_ring:
-            raise ValueError("M-RoPE does not compose with ring prefill yet")
         fn = self._prefill_fn(T, mp, use_pen=pen is not None,
                               use_mask=mask is not None, use_lora=use_lora,
                               use_ring=use_ring, use_embeds=mm is not None,
                               use_mrope=rope_pos is not None)
-        args = [
-            self.params,
-            self.inv_freq,
-            jnp.asarray(tokens),
-            jnp.int32(prefix_len),
-            jnp.int32(t),
-            self.k_cache,
-            self.v_cache,
-            jnp.asarray(page_table, jnp.int32),
+        args = base_args + [
             self._next_key(),
             jnp.asarray([temperature], jnp.float32),
             jnp.asarray([top_k], jnp.int32),
@@ -1025,21 +1126,33 @@ class ModelRunner:
             ]
         if mask is not None:
             args.append(jnp.asarray(mask)[None])
-        if use_lora:
-            args += [self._lora_bank, jnp.int32(lora_idx)]
-        if mm is not None:
-            embeds, emask = mm
-            pe = np.zeros((T, embeds.shape[1]), np.float32)
-            pe[:t] = embeds
-            pm = np.zeros(T, bool)
-            pm[:t] = emask
-            args += [jnp.asarray(pe), jnp.asarray(pm)]
-        if rope_pos is not None:
-            rp = np.zeros((3, T), np.int32)
-            rp[:, :t] = rope_pos
-            args.append(jnp.asarray(rp))
+        args += tail_args
         tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
+
+    def prefill_extend(
+        self,
+        token_ids: list[int],
+        prefix_len: int,
+        page_table: np.ndarray,  # [<= max_pages_per_seq] int32
+        lora_idx: int = 0,
+        mm: tuple | None = None,  # (embeds [t, E] f32, emask [t] bool)
+        rope_pos: "np.ndarray | None" = None,  # [3, t] M-RoPE position ids
+    ) -> None:
+        """Write one NON-final prefill chunk's KV and return immediately
+        (async dispatch; nothing sampled, no key fold, nothing fetched).
+        The budgeted scheduler advances a ``PREFILLING`` request's cursor
+        with this between steps; the FINAL chunk goes through ``prefill``,
+        which samples the first token."""
+        T, mp, base_args, use_lora, use_ring, tail_args = \
+            self._prefill_chunk_prep(
+                token_ids, prefix_len, page_table, lora_idx, mm, rope_pos
+            )
+        fn = self._prefill_extend_fn(T, mp, use_lora=use_lora,
+                                     use_ring=use_ring,
+                                     use_embeds=mm is not None,
+                                     use_mrope=rope_pos is not None)
+        self.k_cache, self.v_cache = fn(*(base_args + tail_args))
 
     def _verify_fn(self, T: int, mp: int, use_mrope: bool = False):
         """Speculative verify: one prefill-shaped forward returning the

@@ -6,9 +6,15 @@ SGLang behind ZMQ (``grpc_servicer/.../request_manager.py:48-65``, SURVEY.md
 §3.3) — redesigned for XLA: every device step is a fixed-shape bucketed call
 into ``ModelRunner``; all bookkeeping (pages, slots, stops) lives host-side.
 
-Step shape: admit waiting requests (prefill, chunked under
-``max_prefill_tokens``), then one decode step for every running slot.
-Prefill-priority keeps TTFT low; decode keeps slots saturated.
+Step shape: one prefill phase, then one decode step for every running lane
+— EVERY step.  Under the default ``prefill_mix_policy="stall-free"``,
+``max_prefill_tokens`` is a true PER-STEP budget (Sarathi-Serve): the phase
+resumes in-progress (``PREFILLING``) prefills from their cursors and admits
+waiting prompts into the leftover, so a long prompt advances one chunk per
+step while decode inter-token latency stays flat.  Non-final chunks write
+KV without sampling (no key fold); the final chunk samples the first token
+and promotes the request to a decode lane.  ``"throughput"`` restores the
+legacy drain-the-queue admission (all chunks in one step).
 
 Overlapped pipeline (``SchedulerConfig.overlap_schedule``, default on): the
 decode launch of step N is dispatched BEFORE step N-1's outputs are
@@ -19,9 +25,14 @@ overlap scheduler / vLLM async scheduling, TPU-shaped).  An
 ``InFlightFrame`` records the launch; a speculative lookahead launch chains
 the frame's own device-resident last-token column as the next input.  Any
 divergence from the schedule the synchronous path would have run (finish,
-stop-string rollback, abort, pending admission) discards the frame and
-rewinds the sampling-key counter, which keeps token streams byte-identical
-to ``overlap_schedule off``.  Speculative decoding and grammar-masked
+stop-string rollback, abort) discards the frame and rewinds the
+sampling-key counter, which keeps token streams byte-identical to
+``overlap_schedule off``.  The prefill phase runs every step BEFORE launch
+decisions with a fixed key-fold ordering rule — prefill folds before the
+step's decode fold — so the lookahead SURVIVES admissions that stay
+fold-free (resumable non-final chunks, requests parked ``PREFILLING``,
+waiting-over-budget, back-pressure) and is only suppressed for the one
+step in which a prefill actually samples.  Speculative decoding and grammar-masked
 batches force a sync boundary (their next device call depends on last
 step's host results).  ``DecodeState`` keeps steady-state decode inputs
 (sampling params, penalty scalars, LoRA indices, page tables)
@@ -175,6 +186,15 @@ class Scheduler:
             or self.inflight is not None
         )
 
+    def prefill_inflight_tokens(self) -> int:
+        """Un-prefilled prompt tokens of admitted, in-progress (resumable)
+        prefills — the slot-holding half of the prefill backlog."""
+        return sum(
+            len(r.all_token_ids) - r.prefill_pos
+            for r in self.slots
+            if r is not None and r.status is RequestStatus.PREFILLING
+        )
+
     def loads(self) -> dict:
         running = sum(1 for s in self.slots if s is not None)
         # token-load estimate for dp-aware routing: un-prefilled prompt tokens
@@ -182,13 +202,29 @@ class Scheduler:
         queued = sum(
             len(r.prompt_ids) + r.sampling.max_new_tokens for r in self.waiting
         )
+        prefill_inflight = self.prefill_inflight_tokens()
+        num_prefilling = 0
         for s in self.slots:
             if s is not None:
                 queued += max(s.sampling.max_new_tokens - len(s.output_ids), 0)
+                if s.status is RequestStatus.PREFILLING:
+                    # un-prefilled prompt tokens are still queued work too
+                    queued += len(s.all_token_ids) - s.prefill_pos
+                    num_prefilling += 1
+        # prefill PRESSURE for load-aware routing: work the per-step budget
+        # still has to chew through before new admissions decode (waiting
+        # prompts re-counted here by their full un-cached prompt length)
+        waiting_prompt_tokens = sum(len(r.all_token_ids) for r in self.waiting)
         total_prompt = self.num_cached_prompt_tokens + self.num_computed_prompt_tokens
         out = {
             "num_waiting": len(self.waiting),
             "num_running": running,
+            # chunked-prefill backlog (per-step budget scheduling): slots
+            # mid-prefill, their remaining tokens, and the whole backlog the
+            # router should see as prefill pressure (not just slot occupancy)
+            "num_prefilling": num_prefilling,
+            "prefill_inflight_tokens": prefill_inflight,
+            "prefill_backlog_tokens": prefill_inflight + waiting_prompt_tokens,
             "spec_drafted": self.num_spec_drafted,
             "spec_accepted": self.num_spec_accepted,
             "free_pages": self.pool.free_count,
@@ -208,7 +244,7 @@ class Scheduler:
             "radix_miss_pages": self.num_radix_miss_pages,
             "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
             # overlap pipeline: lookahead launches that stood vs. were
-            # discarded after a schedule change (stop/abort/admission)
+            # discarded after a schedule change (stop/abort/rollback)
             "lookahead_kept": self.num_lookahead_kept,
             "lookahead_discarded": self.num_lookahead_discarded,
         }
@@ -264,6 +300,7 @@ class Scheduler:
                 decode_tokens=self.num_decode_tokens - dc0,
                 running=sum(1 for s in self.slots if s is not None),
                 waiting=len(self.waiting),
+                prefill_inflight_tokens=self.prefill_inflight_tokens(),
                 max_batch=self.sched.max_batch_size,
                 free_pages=self.pool.free_count,
                 total_pages=self.runner.spec.num_pages,
@@ -292,8 +329,11 @@ class Scheduler:
     # The sequence of device calls (prefill/decode, with their folded
     # sampling keys and batch compositions) must therefore be EXACTLY the
     # sequence the sync scheduler would have issued; a lookahead launch that
-    # turns out to mismatch it (a finish, a rollback, a pending admission)
+    # turns out to mismatch it (a finish, a stop-string rollback, an abort)
     # is discarded and the sampling-key counter rewound before relaunching.
+    # The prefill phase runs every step ahead of launch decisions, so
+    # admissions no longer discard — they either fold (suppressing that
+    # step's lookahead launch) or stay fold-free (lookahead survives).
 
     def _step_overlap(self, outputs: list[StepOutput]) -> tuple[float, float, str]:
         """One pipeline iteration; returns (admit_s, fetch_wait_s, outcome)."""
@@ -303,10 +343,12 @@ class Scheduler:
         outcome = "sync"
         if frame is not None and self._frame_stale(frame):
             # the schedule changed while the frame was in flight (stop-string
-            # rollback, abort, external finish, PD adoption, or a submission
-            # behind a kept lookahead): its tokens never existed in the sync
-            # schedule.  Their KV overshoot past each request's final seq_len
-            # never enters the radix cache, so dropping them is safe.
+            # rollback, abort, external finish, PD adoption): its tokens
+            # never existed in the sync schedule.  Their KV overshoot past
+            # each request's final seq_len never enters the radix cache, so
+            # dropping them is safe.  This runs BEFORE the prefill phase so
+            # the sampling-key rewind happens while the frame's fold is
+            # still the newest.
             self._discard_frame(frame)
             # only a LOOKAHEAD discard counts toward the kept/discarded
             # metric ratio — a stale cold frame dropped on stop/abort is not
@@ -314,28 +356,47 @@ class Scheduler:
             # loads()' counters; the two surfaces must agree)
             outcome = "discarded" if frame.lookahead else "sync"
             frame = None
+        look = None
         if frame is not None:
-            # launch the NEXT decode chained on the in-flight one BEFORE
-            # fetching its results — the whole point: the deferred fetch +
+            # Key-fold ordering rule: the synchronous step is [prefill
+            # phase][decode launch], and the chained lookahead IS this
+            # step's decode fold, dispatched early (before the frame's
+            # results are fetched — the whole point: the deferred fetch +
             # host bookkeeping below overlap the device computing the
-            # lookahead step
-            look = self._launch_lookahead(frame)
+            # lookahead step).  The early launch is therefore only legal
+            # when this step's prefill phase is provably FOLD-FREE —
+            # ``_prefill_phase_fold_free`` predicts that conservatively.
+            # That is how the pipeline SURVIVES admissions: a resumable
+            # chunk that eats the whole budget, or an empty queue, keeps
+            # the lookahead; any possible sampling prefill downgrades one
+            # step to the sync path.
+            if self._prefill_phase_fold_free():
+                look = self._launch_lookahead(frame)
             fetch_s = self._consume_frame(frame, outputs)
-            if look is not None:
-                if self._frame_stale(look):
-                    # consuming finished/trimmed a lane: the sync schedule
-                    # would repack the batch (and refold the key) — discard
-                    self._discard_frame(look)
-                    outcome = "discarded"
-                else:
-                    self.inflight = look
-                    outcome = "kept"
-        admit_s = 0.0
+        # The prefill phase runs AFTER the consume so admission sees every
+        # slot and page freed by finishes inside the frame — exactly the
+        # capacity the sync schedule's admission would see this step.  (Its
+        # folds stay correctly ordered: when a lookahead was launched the
+        # phase is fold-free by the predictor's guarantee; otherwise this
+        # step's decode fold happens at the tail cold launch, after the
+        # phase.)
+        ta = time.perf_counter()
+        disturbed = self._admit(outputs)
+        admit_s = time.perf_counter() - ta
+        if look is not None:
+            if disturbed or self._frame_stale(look):
+                # ``disturbed`` here means the fold-free predictor lied —
+                # a key folded after the lookahead's; keeping the launch
+                # would desync streams, so discarding is the safe response.
+                # Otherwise: consuming finished/trimmed a lane, and the
+                # sync schedule would repack the batch (and refold the key).
+                self._discard_frame(look)
+                outcome = "discarded"
+            else:
+                self.inflight = look
+                outcome = "kept"
         if self.inflight is None:
-            ta = time.perf_counter()
-            self._admit(outputs)
-            admit_s = time.perf_counter() - ta
-            active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+            active = self._decode_active()
             if active:
                 self.inflight = self._launch_frame(active)
         return admit_s, fetch_s, outcome
@@ -351,14 +412,67 @@ class Scheduler:
             mp_b *= 2
         return min(mp_b, self.mp)
 
+    def _decode_active(self) -> list:
+        """Decode-eligible lanes: resident AND past prefill.  A
+        ``PREFILLING`` slot-holder has no sampled token to feed back yet, so
+        it is invisible to decode (and to frame lane signatures) until its
+        final chunk promotes it.
+
+        Ordered by ADMISSION SERIAL, not physical slot: a lane that
+        finishes inside an in-flight frame frees its slot only at consume
+        time, so the same admission can land in different slot numbers
+        under the overlap and sync schedules.  Per-row sampling keys follow
+        row order — serial order is schedule-invariant, slot order is not,
+        and byte-identical streams require the former."""
+        act = [
+            (i, r) for i, r in enumerate(self.slots)
+            if r is not None and r.status is RequestStatus.RUNNING
+        ]
+        act.sort(key=lambda t: t[1].sched_serial)
+        return act
+
+    def _prefill_phase_fold_free(self) -> bool:
+        """Conservatively predict, BEFORE the in-flight frame is consumed,
+        that this step's prefill phase cannot fold a sampling key (no final
+        chunk, no admission).  The chained lookahead — this step's decode
+        fold — is dispatched ahead of the phase, and sync folds prefill
+        before decode, so the early launch is only legal under this
+        guarantee.
+
+        Conservative means: may return False and cost one lookahead (that
+        step runs the sync path), never wrongly True.  Admission capacity
+        (slots/pages freed by finishes INSIDE the frame) is unknowable
+        pre-consume, so any POSSIBLE admission predicts False — the phase
+        itself then runs post-consume and sees exactly the capacity the
+        sync schedule would.  What remains predictable: the oldest
+        ``PREFILLING`` continuation's next chunk is final iff its remainder
+        fits the budget (fold), and a non-final chunk eats the entire
+        budget, making every admission impossible regardless of capacity —
+        the waiting-over-budget case where the lookahead survives."""
+        if self.sched.prefill_mix_policy == "throughput":
+            # legacy drain: any waiting request may admit (and fold)
+            return not self.waiting
+        budget = self.sched.max_prefill_tokens
+        cont = [
+            r for r in self.slots
+            if r is not None and r.status is RequestStatus.PREFILLING
+        ]
+        if cont:
+            first = min(cont, key=lambda r: r.sched_serial)
+            if len(first.all_token_ids) - first.prefill_pos <= budget:
+                return False  # final chunk will sample this step
+            budget = 0  # the non-final chunk consumes the whole budget
+        return budget == 0 or not self.waiting
+
     def _frame_stale(self, frame: InFlightFrame) -> bool:
         """True when the frame no longer matches the schedule the sync path
-        would run: any lane released/rolled back, the active set changed, or
-        (lookahead only) a submission is waiting — sync admits BEFORE
-        decoding, so the lookahead's key fold is out of order."""
-        if frame.lookahead and self.waiting:
-            return True
-        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        would run: any lane released/rolled back, or the decode lane set
+        changed.  A waiting queue no longer stales a lookahead by itself:
+        the prefill phase runs every step BEFORE launch decisions, so an
+        admission either folds a key there (which suppresses the next
+        lookahead) or parks the request ``PREFILLING`` outside the lane set
+        — either way the frame in flight still matches the sync schedule."""
+        active = self._decode_active()
         if len(active) != len(frame.lanes):
             return True
         for (slot, req, expected), (i, r) in zip(frame.lanes, active):
@@ -427,9 +541,11 @@ class Scheduler:
         """Chained launch for the step AFTER ``frame``, dispatched before
         ``frame`` is consumed.  Input tokens are the frame's last sampled
         column (device-resident — no host round trip); positions advance by
-        the horizon.  Returns None when the next step is not predictable:
+        the horizon.  The caller only launches after a fold-free prefill
+        phase (see ``_step_overlap``) — a waiting queue that is over budget
+        or back-pressured does NOT suppress the launch.  Returns None when
+        the next step is not predictable:
 
-        - a submission is waiting (sync admits, folding prefill keys, first);
         - any lane is grammar-constrained (the vocab mask is host-derived
           from last step's token — the structured-output forced-sync case);
         - any lane will deterministically finish inside the frame being
@@ -439,8 +555,6 @@ class Scheduler:
           free pool (eviction/preemption here would diverge from the sync
           schedule's, which runs AFTER finishes release pages).
         """
-        if self.waiting:
-            return None
         H = frame.horizon
         ps = self.ps
         max_seq = self.sched.max_seq_len
@@ -495,90 +609,115 @@ class Scheduler:
             use_mrope=frame.use_mrope, rng_mark=mark, lookahead=True,
         )
 
-    # ---- admission / prefill ----
+    # ---- admission / prefill (the per-step prefill phase) ----
 
-    def _admit(self, outputs: list[StepOutput]) -> None:
+    def _admit(self, outputs: list[StepOutput]) -> bool:
+        """Run this step's prefill phase under the configured mix policy.
+
+        Returns True when any SAMPLING prefill ran — i.e. a key was folded
+        and/or the decode lane set grew.  The overlap pipeline keys the
+        lookahead-launch decision off this: a fold-free phase (non-final
+        resumable chunks, back-pressure, over-budget waiting) leaves the
+        global key-fold order untouched, so a chained decode launch stays
+        byte-identical to the synchronous schedule."""
+        if self.sched.prefill_mix_policy == "throughput":
+            return self._admit_legacy(outputs)
+        return self._admit_budgeted(outputs)
+
+    def _admit_budgeted(self, outputs: list[StepOutput]) -> bool:
+        """Stall-free chunked-prefill scheduling (Sarathi-style): spend at
+        most ONE ``max_prefill_tokens`` budget per step, split between
+
+        1. resuming ``PREFILLING`` slot-holders from their cursors (oldest
+           admission first), then
+        2. admitting waiting prompts into leftover budget — whole short
+           prompts batch through the grouped prefill; a prompt bigger than
+           the leftover packs its first ``budget``-sized chunk and parks in
+           its slot as ``PREFILLING`` (slivers under one page wait instead).
+
+        Non-final chunks write KV only (no sampling, no key fold —
+        ``runner.prefill_extend``); the FINAL chunk samples the request's
+        first token and promotes it to a decode lane.  ``_decode`` runs
+        every step regardless, so running lanes never observe more than
+        ~one chunk of added latency while a long prompt streams in."""
+        sched = self.sched
+        budget = sched.max_prefill_tokens
+        disturbed = False
+        cont = sorted(
+            (r for r in self.slots
+             if r is not None and r.status is RequestStatus.PREFILLING),
+            key=lambda r: r.sched_serial,
+        )
+        for req in cont:
+            if budget <= 0:
+                break
+            remaining = len(req.all_token_ids) - req.prefill_pos
+            if remaining <= budget:
+                budget -= remaining
+                self._prefill_final(req, outputs)
+                disturbed = True
+            else:
+                if budget < min(self.ps, sched.max_prefill_tokens):
+                    # sub-page leftover from an earlier final: a bucketed
+                    # dispatch for a sliver isn't worth it — same rule
+                    # admission applies.  (A FULL budget always runs, even
+                    # one configured below page_size, so progress is
+                    # guaranteed.)
+                    break
+                self._prefill_chunk(req, budget)
+                budget = 0
+        group: list[EngineRequest] = []
+        while budget > 0 and self.waiting:
+            got = self._try_admit_head(outputs, budget_left=budget)
+            if got is None:
+                break  # no slot, page back-pressure, or sliver-sized leftover
+            if got == "consumed":
+                continue  # head finished without admission (error / 0-budget)
+            req = got
+            remaining = len(req.all_token_ids) - req.prefill_pos
+            if remaining <= budget:
+                budget -= remaining
+                group.append(req)
+                if len(group) >= sched.max_prefill_group:
+                    self._prefill_group(group, outputs)
+                    disturbed = True
+                    group = []
+            else:
+                # over budget: pack the leftover as the first resumable chunk
+                self._prefill_chunk(req, budget)
+                budget = 0
+        if group:
+            self._prefill_group(group, outputs)
+            disturbed = True
+        return disturbed
+
+    def _admit_legacy(self, outputs: list[StepOutput]) -> bool:
+        """Drain-the-queue admission (``prefill_mix_policy="throughput"``):
+        every admissible request prefills THIS step, long prompts looping
+        all their chunks back-to-back — maximal prefill throughput, at the
+        cost of stalling decode for the whole drain."""
+        disturbed = False
         while self.waiting:
             # collect a group of admissible single-chunk prompts; long prompts
             # run solo through the chunk loop
             group: list[EngineRequest] = []
             admitted_any = False
             while self.waiting and len(group) < self.sched.max_prefill_group:
-                free_slots = [i for i, s in enumerate(self.slots) if s is None]
-                if not free_slots:
+                got = self._try_admit_head(outputs)
+                if got is None:
                     break
-                req = self.waiting[0]
-                prompt = req.all_token_ids  # includes prior output after preemption
-                if len(prompt) + 1 > self.sched.max_seq_len:
-                    self.waiting.popleft()
-                    req.status = RequestStatus.FINISHED
-                    req.finish = FinishInfo(
-                        reason="error",
-                        message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
-                    )
-                    self._count_finish("error")
-                    outputs.append(StepOutput(req, [], True, req.finish))
+                if got == "consumed":
                     continue
-                if req.sampling.max_new_tokens == 0:
-                    self.waiting.popleft()
-                    req.status = RequestStatus.FINISHED
-                    req.finish = FinishInfo(reason="length")
-                    self._count_finish("length")
-                    outputs.append(StepOutput(req, [], True, req.finish))
-                    continue
-
-                # radix prefix match (never match the full prompt: at least
-                # one token must be computed to produce logits).
-                # mm requests participate via per-page content-hash extra
-                # keys (reference approach): identical placeholder token
-                # runs with different pixels hash to different chains, so
-                # repeated image prompts DO share KV instead of re-encoding
-                shared_pages: list[int] = []
-                node = None
-                if self.radix is not None:
-                    shared_pages, node = self.radix.match_prefix(
-                        prompt[:-1],
-                        extra_keys=self._mm_extra_keys(req, len(prompt)),
-                    )
-                matched_tokens = len(shared_pages) * self.ps
-                prompt_pages_total = math.ceil(len(prompt) / self.ps)
-                need = prompt_pages_total - len(shared_pages)
-
-                if not self._ensure_free_pages(need + self.sched.watermark_pages):
-                    break  # back-pressure: wait for pages
-
-                self.waiting.popleft()
+                req = got
                 admitted_any = True
-                # admission-time hit-rate accounting (once per admission; a
-                # preempted request re-admits and recounts — its re-prefill
-                # really does re-read/re-compute those tokens)
-                self.num_cached_prompt_tokens += matched_tokens
-                self.num_computed_prompt_tokens += len(prompt) - matched_tokens
-                self.num_radix_hit_pages += len(shared_pages)
-                self.num_radix_miss_pages += need
-                if node is not None:
-                    self.radix.lock(node)
-                req.radix_node = node
-                req.shared_pages = shared_pages
-                req.cached_tokens = matched_tokens
-                req.owned_pages = self.pool.alloc(need)
-                req.status = RequestStatus.RUNNING
-
-                slot = free_slots[0]
-                req.slot = slot
-                row = self.page_tables[slot]
-                row[:] = 0
-                all_pages = shared_pages + req.owned_pages
-                row[: len(all_pages)] = all_pages
-                self.slots[slot] = req
-                self._pages_dirty = True
-
-                remaining = len(prompt) - matched_tokens
+                disturbed = True
+                prompt = req.all_token_ids
+                remaining = len(prompt) - req.cached_tokens
                 if remaining > self.sched.max_prefill_tokens:
                     # long prompts chunk through the solo loop; short ones
                     # batch — including under serving pp and M-RoPE (the
                     # grouped forward takes pp_mesh + per-row rope ids)
-                    self._prefill_solo(req, prompt, matched_tokens, outputs)
+                    self._prefill_solo(req, prompt, req.cached_tokens, outputs)
                 else:
                     # mm requests batch like text: the group path splices
                     # per-row embeddings (r3 forced them solo — weak #6)
@@ -586,7 +725,160 @@ class Scheduler:
             if group:
                 self._prefill_group(group, outputs)
             if not admitted_any:
-                return
+                return disturbed
+        return disturbed
+
+    def _try_admit_head(
+        self, outputs: list[StepOutput], budget_left: int | None = None
+    ):
+        """Admit the head of the waiting queue into a free slot: radix-match
+        its prefix, allocate pages for the WHOLE prompt (back-pressure
+        applies here, not mid-prefill), and park it as ``PREFILLING`` with
+        the cursor at the matched prefix — the caller decides how much of it
+        prefills this step.  Returns the request on admission, ``None`` when
+        blocked (no slot / pages / the leftover ``budget_left`` is a
+        sub-page sliver not worth a chunk), or ``"consumed"`` when the head
+        finished without admission (error / zero-token budget)."""
+        free_slots = [i for i, s in enumerate(self.slots) if s is None]
+        if not free_slots:
+            return None
+        req = self.waiting[0]
+        prompt = req.all_token_ids  # includes prior output after preemption
+        if len(prompt) + 1 > self.sched.max_seq_len:
+            self.waiting.popleft()
+            req.status = RequestStatus.FINISHED
+            req.finish = FinishInfo(
+                reason="error",
+                message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
+            )
+            self._count_finish("error")
+            outputs.append(StepOutput(req, [], True, req.finish))
+            return "consumed"
+        if req.sampling.max_new_tokens == 0:
+            self.waiting.popleft()
+            req.status = RequestStatus.FINISHED
+            req.finish = FinishInfo(reason="length")
+            self._count_finish("length")
+            outputs.append(StepOutput(req, [], True, req.finish))
+            return "consumed"
+
+        # radix prefix match (never match the full prompt: at least
+        # one token must be computed to produce logits).
+        # mm requests participate via per-page content-hash extra
+        # keys (reference approach): identical placeholder token
+        # runs with different pixels hash to different chains, so
+        # repeated image prompts DO share KV instead of re-encoding
+        shared_pages: list[int] = []
+        node = None
+        if self.radix is not None:
+            shared_pages, node = self.radix.match_prefix(
+                prompt[:-1],
+                extra_keys=self._mm_extra_keys(req, len(prompt)),
+            )
+        matched_tokens = len(shared_pages) * self.ps
+        remaining = len(prompt) - matched_tokens
+        if (
+            budget_left is not None
+            and remaining > budget_left
+            and budget_left < min(self.ps, self.sched.max_prefill_tokens)
+        ):
+            return None  # sliver: cheaper to wait for next step's full budget
+        prompt_pages_total = math.ceil(len(prompt) / self.ps)
+        need = prompt_pages_total - len(shared_pages)
+
+        # pin the matched chain BEFORE the free-page check: the check may
+        # EVICT from the radix cache, and an unpinned matched prefix is fair
+        # game — ``shared_pages`` would then reference freed (re-allocatable)
+        # pages.  Routinely hit since mid-prefill preemption banks partial
+        # prefixes that readmission immediately matches under page pressure.
+        if node is not None:
+            self.radix.lock(node)
+        if not self._ensure_free_pages(need + self.sched.watermark_pages):
+            if node is not None:
+                self.radix.unlock(node)
+            return None  # back-pressure: wait for pages
+
+        self.waiting.popleft()
+        # admission-time hit-rate accounting (once per admission; a
+        # preempted request re-admits and recounts — its re-prefill
+        # really does re-read/re-compute those tokens)
+        self.num_cached_prompt_tokens += matched_tokens
+        self.num_computed_prompt_tokens += remaining
+        self.num_radix_hit_pages += len(shared_pages)
+        self.num_radix_miss_pages += need
+        req.radix_node = node
+        req.shared_pages = shared_pages
+        req.cached_tokens = matched_tokens
+        req.owned_pages = self.pool.alloc(need)
+        req.status = RequestStatus.PREFILLING
+        req.prefill_pos = matched_tokens
+        req.seq_len = matched_tokens
+
+        slot = free_slots[0]
+        req.slot = slot
+        row = self.page_tables[slot]
+        row[:] = 0
+        all_pages = shared_pages + req.owned_pages
+        row[: len(all_pages)] = all_pages
+        self.slots[slot] = req
+        self._pages_dirty = True
+        return req
+
+    def _prefill_chunk(self, req: EngineRequest, take: int) -> None:
+        """Advance a resumable prefill by one NON-final chunk: KV writes
+        only, nothing sampled, no key fold (see ``runner.prefill_extend``) —
+        which is what lets a lookahead decode frame stay in flight across
+        this step."""
+        start = req.prefill_pos
+        chunk = req.all_token_ids[start : start + take]
+        self.runner.prefill_extend(
+            chunk,
+            prefix_len=start,
+            page_table=self.page_tables[req.slot],
+            lora_idx=req.lora_idx,
+            mm=self._mm_chunk(req, start, len(chunk)),
+            rope_pos=self._mrope_chunk(req, start, len(chunk)),
+        )
+        self.num_prefill_tokens += len(chunk)
+        req.prefill_pos += len(chunk)
+        req.seq_len = req.prefill_pos
+
+    def _prefill_final(
+        self, req: EngineRequest, outputs: list[StepOutput]
+    ) -> None:
+        """Run the FINAL chunk of a resumable prefill: write the remaining
+        prompt KV, sample the request's first token (this is the prefill key
+        fold the overlap pipeline orders lookahead launches after), and
+        promote the request to a decode lane."""
+        prompt = req.all_token_ids
+        start = req.prefill_pos
+        chunk = prompt[start:]
+        sp = req.sampling
+        pen = None
+        if sp.has_penalties:
+            counts, pmask = self._req_pen_state(req)
+            pen = (counts, pmask, sp.frequency_penalty, sp.presence_penalty,
+                   sp.repetition_penalty)
+        mask = self._mask_for(req) if req.token_filter is not None else None
+        tok, lp = self.runner.prefill(
+            chunk,
+            prefix_len=start,
+            page_table=self.page_tables[req.slot],
+            temperature=sp.temperature,
+            top_k=sp.top_k,
+            top_p=sp.top_p,
+            min_p=sp.min_p,
+            pen=pen,
+            mask=mask,
+            lora_idx=req.lora_idx,
+            mm=self._mm_chunk(req, start, len(chunk)),
+            rope_pos=self._mrope_chunk(req, start, len(chunk)),
+        )
+        self.num_prefill_tokens += len(chunk)
+        req.prefill_pos = len(prompt)
+        req.seq_len = len(prompt)
+        req.status = RequestStatus.RUNNING
+        self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
 
     def _mask_for(self, req: EngineRequest) -> np.ndarray:
         """Constrained-decoding vocab mask for the request's next token.
@@ -639,7 +931,9 @@ class Scheduler:
             )
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)
+            req.prefill_pos = start
         req.seq_len = len(prompt)
+        req.status = RequestStatus.RUNNING
         self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
 
     def _mrope_chunk(self, req: EngineRequest, start: int, n: int):
@@ -777,6 +1071,8 @@ class Scheduler:
         )
         for i, req in enumerate(group):
             req.seq_len = req.total_len
+            req.prefill_pos = req.seq_len
+            req.status = RequestStatus.RUNNING
             self._accept_tokens(
                 # smglint: disable-next=HOTSYNC toks/lps fetched in prefill_batched
                 req, [int(toks[i])], [float(lps[i])], outputs, advance_seq=False
@@ -795,8 +1091,10 @@ class Scheduler:
 
     def _decode(self, outputs: list[StepOutput]) -> None:
         """Synchronous decode: plan + launch + immediate consume (the overlap
-        pipeline calls the same launch/consume halves with a frame between)."""
-        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        pipeline calls the same launch/consume halves with a frame between).
+        Runs EVERY step — a request mid-resumable-prefill holds its slot but
+        never blocks the running lanes from decoding."""
+        active = self._decode_active()
         if not active:
             return
         if self.sched.speculative:
@@ -1129,13 +1427,41 @@ class Scheduler:
         self.page_tables[slot][:] = 0
         self._pages_dirty = True
         req.slot = None
-        self.pool.free(req.owned_pages)
+        if (
+            req.status is RequestStatus.PREFILLING
+            and self.radix is not None
+            and req.prefill_pos >= self.ps
+        ):
+            # Mid-prefill victim: bank the chunks computed so far in the
+            # radix cache instead of discarding them, so readmission RESUMES
+            # from the cursor via a prefix hit rather than recomputing the
+            # whole prompt.  Best-effort by design — the banked pages are
+            # evictable like any cached prefix, so a pool starved enough to
+            # reclaim them degrades to a restart, never to a deadlock.
+            tokens = req.all_token_ids[: req.prefill_pos]
+            full_pages = len(tokens) // self.ps
+            all_pages = req.shared_pages + req.owned_pages
+            n_shared = len(req.shared_pages)
+            to_free: list[int] = []
+            dupes = self.radix.insert(
+                tokens, all_pages[:full_pages],
+                extra_keys=self._mm_extra_keys(req, len(tokens)),
+            )
+            for idx, page in dupes:
+                if idx >= n_shared:
+                    to_free.append(page)
+            to_free.extend(all_pages[full_pages:])
+            if to_free:
+                self.pool.free(to_free)
+        else:
+            self.pool.free(req.owned_pages)
         req.owned_pages = []
         req.shared_pages = []
         if req.radix_node is not None:
             self.radix.unlock(req.radix_node)
             req.radix_node = None
         req.seq_len = 0
+        req.prefill_pos = 0
         req.cached_tokens = 0
         req.penalty_synced = False  # re-derive counts on readmission
         req.draft_len = 0  # draft cache rows are gone with the pages
@@ -1243,6 +1569,7 @@ class Scheduler:
         self.requests[req.rid] = req
         req.owned_pages = list(pages)
         req.seq_len = req.prompt_len
+        req.prefill_pos = req.prompt_len  # prompt KV imported, cursor done
         req.status = RequestStatus.RUNNING
         slot = free_slots[0]
         req.slot = slot

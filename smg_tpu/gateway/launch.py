@@ -72,6 +72,8 @@ def build_engine_from_args(args):
         ),
         scheduler=SchedulerConfig(
             max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len,
+            max_prefill_tokens=getattr(args, "max_prefill_tokens", 4096),
+            prefill_mix_policy=getattr(args, "prefill_mix_policy", "stall-free"),
             speculative=getattr(args, "speculative", False),
             spec_max_draft=getattr(args, "spec_max_draft", 8),
             overlap_schedule=getattr(args, "overlap_schedule", "on") != "off",

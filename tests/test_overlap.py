@@ -315,6 +315,107 @@ def test_engine_stop_drops_inflight():
     assert eng.scheduler.inflight is None
 
 
+def test_chunked_prefill_parity_greedy():
+    # a multi-chunk prompt admits under the per-step budget (64) while a
+    # short one decodes: the resumable-prefill steps are fold-free, so the
+    # pipeline keeps lookahead frames across them — streams must still be
+    # byte-identical to the sync path
+    jobs = [
+        ("long", list(range(5, 155)), greedy(8)),
+        ("c0", list(range(200, 230)), greedy(14)),
+    ]
+    assert_parity(jobs)
+
+
+def test_chunked_prefill_parity_sampled():
+    # temp 0.8: any key-fold ordering slip between the chunked prefill and
+    # the chained decode launches flips the sampled streams
+    jobs = [
+        ("long", list(range(5, 185)),
+         SamplingParams(temperature=0.8, top_k=40, max_new_tokens=10,
+                        ignore_eos=True)),
+        ("c0", list(range(200, 240)),
+         SamplingParams(temperature=0.8, max_new_tokens=12, ignore_eos=True)),
+        ("c1", list(range(250, 275)), greedy(9)),
+    ]
+    assert_parity(jobs, decode_horizon=2)
+
+
+def test_chunked_prefill_parity_legacy_policy():
+    # the legacy drain-the-queue policy must keep its own overlap/sync parity
+    jobs = [
+        ("long", list(range(5, 155)), greedy(8)),
+        ("c0", list(range(200, 230)),
+         SamplingParams(temperature=0.9, max_new_tokens=8, ignore_eos=True)),
+    ]
+    assert_parity(jobs, prefill_mix_policy="throughput")
+
+
+def test_admission_on_slot_freed_by_inflight_finish_parity():
+    # max_batch 2: request "b" waits for a slot that only frees when "a"
+    # finishes INSIDE the in-flight frame.  Sync admits "b" the same step
+    # the slot frees; the overlap prefill phase must therefore run with
+    # post-consume capacity (regression: admission ran pre-consume, saw the
+    # free slot one step late, and shifted the sampling-key fold order)
+    jobs = [
+        ("a", list(range(5, 15)),
+         SamplingParams(temperature=0.8, max_new_tokens=3, ignore_eos=True)),
+        ("c", list(range(30, 50)),
+         SamplingParams(temperature=0.8, max_new_tokens=20, ignore_eos=True)),
+        ("b", list(range(60, 85)),
+         SamplingParams(temperature=0.8, max_new_tokens=8, ignore_eos=True)),
+    ]
+    assert_parity(jobs, max_batch=2)
+
+
+def test_admission_on_pages_freed_by_inflight_finish_parity():
+    # same shape under PAGE pressure: "b" back-pressures on pages released
+    # by "a"'s in-frame finish
+    jobs = [
+        ("a", list(range(5, 40)),
+         SamplingParams(temperature=0.8, max_new_tokens=4, ignore_eos=True)),
+        ("c", list(range(50, 80)),
+         SamplingParams(temperature=0.8, max_new_tokens=16, ignore_eos=True)),
+        ("b", list(range(100, 140)),
+         SamplingParams(temperature=0.8, max_new_tokens=6, ignore_eos=True)),
+    ]
+    assert_parity(jobs, num_pages=16, max_batch=4, max_seq_len=128)
+
+
+def test_lookahead_survives_admission_over_budget():
+    # historically ANY waiting request forced the pipeline sync (kept
+    # required an empty queue).  Now: a long prompt mid-resumable-prefill
+    # consumes the whole per-step budget, a second prompt waits over budget
+    # — and the running lane's lookahead frames stay KEPT through both.
+    from smg_tpu.engine.request import RequestStatus
+
+    eng = make_engine(True)
+    got: list = []
+    eng.submit(list(range(5, 30)), greedy(48), rid="a",
+               on_output=lambda o: got.append(o))
+    for _ in range(3):  # admit + prime the pipeline
+        eng.step()
+    eng.submit(list(range(40, 220)), greedy(4), rid="long")  # 3 chunks @ 64
+    eng.submit(list(range(300, 330)), greedy(4), rid="w")  # over budget
+    sched = eng.scheduler
+    kept_while_waiting = 0
+    saw_prefilling = False
+    for _ in range(4):
+        kept0 = sched.num_lookahead_kept
+        eng.step()
+        lr = sched.requests.get("long")
+        if lr is not None and lr.status is RequestStatus.PREFILLING:
+            saw_prefilling = True
+            assert 0 < lr.prefill_pos < 180
+        if sched.num_lookahead_kept > kept0 and sched.waiting:
+            kept_while_waiting += 1
+    assert saw_prefilling
+    assert kept_while_waiting > 0  # the pipeline rode across the admission
+    while sched.has_work():
+        eng.step()
+    assert not sched.requests  # everyone drained to completion
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("horizon", [1, 2, 4])
 def test_exhaustive_parity_sweep(horizon):
