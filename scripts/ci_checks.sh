@@ -12,7 +12,12 @@
 #                       stream parity under the per-step prefill budget,
 #                       plus mid-prefill preemption/abort lifecycle
 #                       (tests/test_chunked_prefill.py + the chunked cases
-#                       in tests/test_overlap.py).
+#                       in tests/test_overlap.py);
+#   5. reliability    — engine failure isolation driven through the
+#                       smg_tpu/faults.py fault points: poison-step
+#                       quarantine (survivor byte-parity + zero leaks),
+#                       deadlines, backpressure, watchdog, drain
+#                       (tests/test_reliability.py).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -31,5 +36,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
 echo "== chunked-prefill scheduling parity =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chunked_prefill.py \
     tests/test_overlap.py -q -m 'not slow' -p no:cacheprovider
+
+echo "== reliability / failure isolation =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_reliability.py -q \
+    -m 'not slow' -p no:cacheprovider
 
 echo "ci_checks: all green"

@@ -158,6 +158,17 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
     rl.add_argument("--disable-health-check", action="store_true")
     rl.add_argument("--worker-startup-timeout-secs", type=float, default=75.0,
                     help="budget for startup worker registration workflows")
+    rl.add_argument("--worker-stream-idle-timeout-secs", type=float,
+                    default=None, dest="worker_stream_idle_timeout_secs",
+                    help="per-CHUNK idle bound on gRPC worker generate "
+                         "streams: no token for N secs counts as a worker "
+                         "failure (retry/breaker engage); 0 disables "
+                         "(default: 120, the client's built-in)")
+    rl.add_argument("--engine-drain-timeout-secs", type=float, default=10.0,
+                    dest="engine_drain_timeout_secs",
+                    help="SIGTERM drain budget for in-proc engines: queued "
+                         "requests get terminal aborts, running lanes "
+                         "finish within this bound before exit")
 
     sched = p.add_argument_group("Scheduling / limits")
     sched.add_argument("--priority-scheduler-enabled", action="store_true")
@@ -253,6 +264,20 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--max-batch-size", type=int, default=64)
     g.add_argument("--max-seq-len", type=int, default=8192)
     g.add_argument("--page-size", type=int, default=16)
+    g.add_argument("--max-queued-requests", type=int, default=0,
+                   dest="max_queued_requests",
+                   help="bound the engine waiting queue: submits beyond "
+                        "this are rejected retryably (RESOURCE_EXHAUSTED -> "
+                        "router retry-other-worker / 429); 0 = unbounded")
+    g.add_argument("--max-queued-tokens", type=int, default=0,
+                   dest="max_queued_tokens",
+                   help="token-denominated waiting-queue bound (0 = off)")
+    g.add_argument("--step-watchdog-secs", type=float, default=0.0,
+                   dest="step_watchdog_secs",
+                   help="flag the engine unhealthy when no step completes "
+                        "for N secs while work is pending (wedged device "
+                        "fetch); 0 disables — XLA first-compiles can "
+                        "legitimately take minutes, enable once warm")
     g.add_argument("--metrics-window-secs", type=float, default=30.0,
                    dest="metrics_window_secs",
                    help="rolling-stats horizon for engine step telemetry "

@@ -101,6 +101,14 @@ class SchedulerConfig:
     #                   waiting queue drains before decode (maximizes prefill
     #                   throughput, stalls decode ITL under long prompts).
     prefill_mix_policy: str = "stall-free"
+    # admission backpressure: bound the WAITING queue so an overloaded engine
+    # rejects at submit (retryable QueueFullError -> RESOURCE_EXHAUSTED ->
+    # router retry-another-worker / 429) instead of growing host memory and
+    # queue latency without limit.  0 = unbounded (legacy behavior).
+    max_queued_requests: int = 0
+    # token-denominated variant of the same bound: waiting prompt tokens plus
+    # the incoming prompt must fit.  0 = unbounded.
+    max_queued_tokens: int = 0
     # overlapped decode pipeline (one-step lookahead): the step loop launches
     # the next decode before last step's outputs are consumed, so host-side
     # work (detokenize, stop strings, admission bookkeeping) hides behind
@@ -168,6 +176,17 @@ class EngineConfig:
     # device.memory_stats() HBM gauges (0 disables device sampling)
     metrics_window_secs: float = 30.0
     device_metrics_interval_secs: float = 10.0
+    # ---- failure isolation ----
+    # step watchdog: a separate thread that flags the engine unhealthy when
+    # no step completes for this many seconds while work is pending (a
+    # wedged device fetch / runaway compile).  0 disables (the default:
+    # legitimate XLA first-compiles can take minutes on loaded CPU CI;
+    # enable in production once the engine is warm).
+    step_watchdog_secs: float = 0.0
+    # N consecutive failed steps flip the engine unhealthy: loads()["healthy"]
+    # and the RPC health() go false so HealthMonitor + circuit breakers route
+    # around the worker while it keeps retrying.
+    max_consecutive_step_failures: int = 3
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

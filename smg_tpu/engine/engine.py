@@ -125,6 +125,14 @@ class Engine:
         self._thread: threading.Thread | None = None
         self._stopping = False
         self.start_time = time.monotonic()
+        # failure isolation: step-watchdog state.  ``_last_progress`` is a
+        # bare float written by the step thread and read by the watchdog
+        # WITHOUT the engine lock — the watchdog must never block on a lock
+        # the wedged step thread is holding.
+        self._watchdog: threading.Thread | None = None
+        self._last_progress = time.monotonic()
+        self._stalled = False
+        self.num_watchdog_stalls = 0
 
     # ---- submission ----
 
@@ -136,11 +144,20 @@ class Engine:
         on_output=None,
         priority: int = 0,
         mm_embeds: tuple | None = None,  # (embeds [M, E] f32, positions [M])
+        timeout_secs: float | None = None,
     ) -> str:
+        """Queue a request.  ``timeout_secs`` is the remaining client budget:
+        the scheduler expires it in queue or aborts it mid-generation with a
+        terminal ``timeout`` finish once the budget runs out.  Raises
+        ``QueueFullError`` (retryable) under admission backpressure."""
         rid = rid or f"req-{uuid.uuid4().hex[:16]}"
         req = EngineRequest(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling, priority=priority
         )
+        if timeout_secs is not None:
+            # an exhausted budget (<= 0) still submits: the first sweep
+            # returns the terminal "timeout" through the normal output path
+            req.deadline = time.monotonic() + max(timeout_secs, 0.0)
         if mm_embeds is not None:
             import numpy as np
 
@@ -184,7 +201,10 @@ class Engine:
         if sampling.lora_adapter:
             req.lora_idx = self.runner.lora_index(sampling.lora_adapter)
         with self._wakeup:
-            self.scheduler.add_request(req)
+            self.scheduler.add_request(req)  # may raise QueueFullError
+            # fresh work resets the watchdog clock: stall time is measured
+            # from "work existed and no step completed", not from engine idle
+            self._last_progress = time.monotonic()
             if on_output is not None:
                 self._callbacks[rid] = on_output
             self._wakeup.notify_all()
@@ -246,9 +266,25 @@ class Engine:
             self._callbacks.pop(rid, None)
             return ok
 
+    @property
+    def healthy(self) -> bool:
+        """Engine-level health: false while the step watchdog sees a stall,
+        or after N consecutive failed steps (``max_consecutive_step_failures``).
+        Surfaced through ``loads()`` and the RPC ``health()`` so the
+        gateway's HealthMonitor + circuit breakers route around a poisoned
+        or wedged worker instead of queueing onto it."""
+        return (
+            not self._stalled
+            and self.scheduler.consec_step_failures
+            < self.config.max_consecutive_step_failures
+        )
+
     def loads(self) -> dict:
         with self._lock:
-            return self.scheduler.loads()
+            out = self.scheduler.loads()
+        out["healthy"] = self.healthy
+        out["watchdog_stalls"] = self.num_watchdog_stalls
+        return out
 
     def flush_cache(self) -> bool:
         with self._lock:
@@ -513,6 +549,12 @@ class Engine:
                     finally:
                         self._profiling = False
                         self._profile_steps_left = None
+        # watchdog progress mark + stall recovery (a step completed end to
+        # end, so a previously-flagged wedge has cleared)
+        self._last_progress = time.monotonic()
+        if self._stalled:
+            self._stalled = False
+            logger.warning("engine step progress resumed; stall cleared")
         for out in outputs:
             cb = self._callbacks.get(out.rid)
             if cb is not None:
@@ -590,16 +632,97 @@ class Engine:
         if self._thread is not None:
             return
         self._stopping = False
+        self._last_progress = time.monotonic()
         self._thread = threading.Thread(target=self._loop, name="smg-engine", daemon=True)
         self._thread.start()
+        if self.config.step_watchdog_secs > 0 and self._watchdog is None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="smg-engine-watchdog", daemon=True
+            )
+            self._watchdog.start()
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, timeout: float = 30.0) -> None:
+        """Stop the engine.  ``drain=True`` first stops admission, fails
+        every still-queued request with a terminal ``abort`` output (clients
+        see an end, not a hang), and waits up to ``timeout`` seconds for the
+        admitted lanes (RUNNING and mid-prefill) to finish streaming before
+        the loop is torn down."""
+        if drain:
+            with self._wakeup:
+                self.scheduler.draining = True
+                step_outs: list = []
+                self.scheduler.drain_waiting(step_outs)
+                outputs = [self._postprocess(so) for so in step_outs]
+                self._wakeup.notify_all()
+            for out in outputs:
+                cb = self._callbacks.pop(out.rid, None)
+                if cb is not None:
+                    try:
+                        cb(out)
+                    except Exception:
+                        logger.exception("drain callback failed for %s", out.rid)
+            deadline = time.monotonic() + max(timeout, 0.0)
+            # only wait when a loop is actually running the work down
+            while self._thread is not None and time.monotonic() < deadline:
+                with self._lock:
+                    if not self.scheduler.has_work():
+                        break
+                time.sleep(0.01)
+            else:
+                if self._thread is not None:
+                    logger.warning(
+                        "drain timeout (%.1fs): stopping with work in flight",
+                        timeout,
+                    )
         with self._wakeup:
             self._stopping = True
             self._wakeup.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
+            self._watchdog = None
+
+    def _watchdog_loop(self) -> None:
+        """Step watchdog: flags the engine unhealthy when no step completes
+        for ``step_watchdog_secs`` while work is pending (a wedged device
+        fetch, a runaway compile).  Runs LOCK-FREE — the wedged step thread
+        is usually holding the engine lock, so the watchdog only reads
+        scheduler state (racy but monotonic enough for a threshold check)
+        and takes the lock opportunistically to abort the in-flight frame."""
+        T = self.config.step_watchdog_secs
+        poll = max(min(T / 4.0, 1.0), 0.01)
+        logger.info("engine step watchdog started (threshold %.1fs)", T)
+        while not self._stopping:
+            time.sleep(poll)
+            if self._stopping:
+                break
+            try:
+                has_work = self.scheduler.has_work()  # unlocked read, see above
+            except Exception:
+                continue
+            stalled_for = time.monotonic() - self._last_progress
+            if not has_work or stalled_for <= T:
+                continue
+            if not self._stalled:
+                self._stalled = True
+                self.num_watchdog_stalls += 1
+                self.metrics.watchdog_stalls.inc()
+                logger.error(
+                    "engine wedged: no step progress for %.1fs with work "
+                    "pending; marking unhealthy", stalled_for,
+                )
+                # best-effort in-flight-frame abort: only possible when the
+                # step thread is NOT holding the lock (e.g. wedged outside
+                # the step body); a blocked acquire here would deadlock the
+                # watchdog behind the very stall it is reporting
+                if self._lock.acquire(blocking=False):
+                    try:
+                        self.scheduler.drop_inflight()
+                    finally:
+                        self._lock.release()
+        logger.info("engine step watchdog stopped")
 
     def _loop(self) -> None:
         """Drives the step loop — and, with ``overlap_schedule`` on, the
@@ -620,7 +743,17 @@ class Engine:
             try:
                 self.step()
             except Exception:
-                logger.exception("engine step failed")
+                # last-resort containment: the scheduler's quarantine layer
+                # handles prefill/decode failures in-band, so anything
+                # arriving here escaped blame attribution.  Count it toward
+                # the consecutive-failure health threshold (loads()/health()
+                # go false at N) and keep the loop alive — the gateway
+                # routes around an unhealthy worker while it retries.
+                self.scheduler._count_step_failure("loop")
+                logger.exception(
+                    "engine step failed (%d consecutive)",
+                    self.scheduler.consec_step_failures,
+                )
                 time.sleep(0.1)
         with self._lock:
             # stop() mid-generation: the frame's results will never be
@@ -637,9 +770,17 @@ class Engine:
         text: str | None = None,
         sampling: SamplingParams | None = None,
         rid: str | None = None,
+        timeout_secs: float = 300.0,
     ) -> GenerationResult:
         """Blocking generate.  Drives the loop inline when no background
-        thread is running (tests), otherwise waits on the stream."""
+        thread is running (tests), otherwise waits on the stream.
+
+        ``timeout_secs`` rides the per-request deadline plumbing: an expired
+        generation comes back as a normal result with
+        ``finish_reason="timeout"`` (pages/lane released by the scheduler's
+        sweep), not a raised ``TimeoutError`` with an orphaned abort.  The
+        raise remains only as a backstop for a wedged engine that stops
+        producing outputs at all."""
         sampling = sampling or SamplingParams()
         if prompt_ids is None:
             if text is None or self.tokenizer is None:
@@ -654,16 +795,20 @@ class Engine:
             if out.finished:
                 done.set()
 
-        rid = self.submit(prompt_ids, sampling, rid=rid, on_output=on_output)
+        rid = self.submit(prompt_ids, sampling, rid=rid, on_output=on_output,
+                          timeout_secs=timeout_secs)
+        # backstop margin past the deadline: the sweep itself needs a step
+        # to run, and a truly wedged engine never steps again
+        backstop = timeout_secs + 30.0
         if self._thread is None:
-            deadline = time.monotonic() + 300
+            deadline = time.monotonic() + backstop
             while not done.is_set():
                 self.step()
                 if time.monotonic() > deadline:
                     self.abort(rid)
                     raise TimeoutError(f"generation {rid} timed out")
         else:
-            if not done.wait(timeout=300):
+            if not done.wait(timeout=backstop):
                 self.abort(rid)
                 raise TimeoutError(f"generation {rid} timed out")
 

@@ -236,6 +236,41 @@ class EngineMetrics:
             "prefills — slot-holding prefill backlog",
             registry=r,
         ))
+        # failure isolation (poison-step quarantine / deadlines / watchdog)
+        self.step_failures = _track(Counter(
+            "smg_engine_step_failures_total",
+            "Scheduler steps that raised, by phase (prefill = admission/"
+            "prefill dispatch, decode = batch launch/consume, loop = "
+            "escaped to the engine loop's last-resort handler)",
+            ["phase"], registry=r,
+        ))
+        self.quarantined_requests = _track(Counter(
+            "smg_engine_quarantined_requests_total",
+            "Requests failed with finish_reason=error by poison-step "
+            "quarantine (blamed for a prefill/decode step failure); their "
+            "pages, radix locks, and decode lanes are released while "
+            "surviving lanes keep streaming",
+            registry=r,
+        ))
+        self.deadline_expirations = _track(Counter(
+            "smg_engine_deadline_expirations_total",
+            "Requests finished with reason=timeout by the per-request "
+            "deadline sweep (state: waiting = expired in queue before "
+            "admission, running = aborted mid-generation)",
+            ["state"], registry=r,
+        ))
+        self.queue_rejections = _track(Counter(
+            "smg_engine_queue_rejections_total",
+            "Submits rejected by the bounded waiting queue "
+            "(max_queued_requests / max_queued_tokens backpressure)",
+            registry=r,
+        ))
+        self.watchdog_stalls = _track(Counter(
+            "smg_engine_watchdog_stalls_total",
+            "Step-watchdog detections of a wedged engine (no step progress "
+            "for step_watchdog_secs while work was pending)",
+            registry=r,
+        ))
         # overlapped decode pipeline (scheduler one-step lookahead)
         self.lookahead_launches = _track(Counter(
             "smg_engine_lookahead_launches_total",

@@ -10,6 +10,14 @@ from typing import Any
 from smg_tpu.protocols.sampling import SamplingParams
 
 
+class QueueFullError(RuntimeError):
+    """Admission backpressure: the bounded waiting queue rejected a submit.
+
+    Retryable by design — the RPC layer maps it to RESOURCE_EXHAUSTED and the
+    gateway router to retry-another-worker / HTTP 429 (never a breaker
+    failure: a full queue is load, not fault)."""
+
+
 class RequestStatus(enum.Enum):
     WAITING = "waiting"
     # admitted to a slot, prompt KV partially computed (resumable chunked
@@ -23,7 +31,7 @@ class RequestStatus(enum.Enum):
 
 @dataclass
 class FinishInfo:
-    reason: str  # "stop" | "length" | "abort" | "error"
+    reason: str  # "stop" | "length" | "abort" | "error" | "timeout"
     matched_stop: str | int | None = None
     message: str | None = None
 
@@ -35,6 +43,10 @@ class EngineRequest:
     sampling: SamplingParams
     arrival_time: float = field(default_factory=time.monotonic)
     priority: int = 0
+    # absolute time.monotonic() deadline (None = no deadline).  The scheduler
+    # expires WAITING requests before admission and aborts RUNNING lanes past
+    # it, both with finish reason "timeout" (engine failure-isolation layer).
+    deadline: float | None = None
 
     # runtime
     status: RequestStatus = RequestStatus.WAITING

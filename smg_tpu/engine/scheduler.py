@@ -58,10 +58,12 @@ from smg_tpu.engine.radix_cache import RadixCache
 from smg_tpu.engine.request import (
     EngineRequest,
     FinishInfo,
+    QueueFullError,
     RequestStatus,
     StepOutput,
 )
 from smg_tpu.engine.runner import DecodeState, ModelRunner
+from smg_tpu.faults import FAULTS
 from smg_tpu.utils import get_logger
 
 logger = get_logger("engine.scheduler")
@@ -151,16 +153,59 @@ class Scheduler:
         self._serial = 0  # admission serial for decode-state signatures
         self.num_lookahead_kept = 0
         self.num_lookahead_discarded = 0
+        # failure isolation (poison-step quarantine / deadlines / drain)
+        self.num_quarantined = 0
+        self.num_step_failures = 0
+        self.consec_step_failures = 0  # reset by any clean step
+        self._step_had_failure = False  # set within a step by _count_step_failure
+        self.num_queue_rejections = 0
+        self.num_deadline_waiting = 0
+        self.num_deadline_running = 0
+        # drain mode (engine.stop(drain=True)): admission stops — in-progress
+        # PREFILLING continuations and RUNNING lanes still finish
+        self.draining = False
 
     # ---- public API ----
 
     def add_request(self, req: EngineRequest) -> None:
         if req.rid in self.requests:
             raise ValueError(f"duplicate request id {req.rid}")
+        if self.draining:
+            # a submit racing stop(drain=True) lands after the drain sweep:
+            # accepting it would queue a request no admission loop will ever
+            # touch (silent client hang).  QueueFullError is the right shape
+            # — retryable on another worker, 429 at the front door.
+            raise QueueFullError("engine draining; retry on another worker")
+        self._check_queue_capacity(req)
         self._serial += 1
         req.sched_serial = self._serial
         self.requests[req.rid] = req
         self.waiting.append(req)
+
+    def _check_queue_capacity(self, req: EngineRequest) -> None:
+        """Bounded-queue backpressure at submit time.  Only NEW submissions
+        are bounded — preemption victims re-enter ``waiting`` directly (they
+        already hold an admission, rejecting them would lose work)."""
+        sched = self.sched
+        full = bool(
+            sched.max_queued_requests
+            and len(self.waiting) >= sched.max_queued_requests
+        )
+        if not full and sched.max_queued_tokens:
+            # O(len(waiting)) under the engine lock, but self-limiting: the
+            # cap itself bounds the queue this sum walks (every waiting
+            # request holds >= 1 token), so the walk never exceeds
+            # max_queued_tokens entries
+            queued = sum(len(r.all_token_ids) for r in self.waiting)
+            full = queued + len(req.prompt_ids) > sched.max_queued_tokens
+        if full:
+            self.num_queue_rejections += 1
+            if self.metrics is not None:
+                self.metrics.queue_rejections.inc()
+            raise QueueFullError(
+                f"engine waiting queue full ({len(self.waiting)} queued); "
+                "retry on another worker or later"
+            )
 
     def abort_request(self, rid: str) -> bool:
         req = self.requests.get(rid)
@@ -247,6 +292,15 @@ class Scheduler:
             # discarded after a schedule change (stop/abort/rollback)
             "lookahead_kept": self.num_lookahead_kept,
             "lookahead_discarded": self.num_lookahead_discarded,
+            # failure isolation: quarantine/deadline/backpressure counters
+            # the gateway's health + routing decisions key off
+            "quarantined_requests": self.num_quarantined,
+            "step_failures": self.num_step_failures,
+            "consecutive_step_failures": self.consec_step_failures,
+            "queue_rejections": self.num_queue_rejections,
+            "deadline_expirations_waiting": self.num_deadline_waiting,
+            "deadline_expirations_running": self.num_deadline_running,
+            "draining": self.draining,
         }
         if self.metrics is not None:
             # rolling-window live signal (p50/p95 step time, tokens/s) for
@@ -269,8 +323,29 @@ class Scheduler:
     # ---- the step ----
 
     def step(self) -> list[StepOutput]:
+        """One scheduler iteration with failure isolation: prefill failures
+        are quarantined per-request inside the admission phase (see
+        ``_admit_*``); anything that still escapes is a decode-phase failure
+        handled by blame-and-retry (``_recover_decode_failure``) so one
+        poisoned batch never livelocks the engine."""
         outputs: list[StepOutput] = []
+        self._step_had_failure = False
+        try:
+            self._step_inner(outputs)
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            self._recover_decode_failure(outputs, e)
+        else:
+            if not self._step_had_failure:
+                # only a step with NO recorded failure resets the streak —
+                # a step that quarantined a prefill failure completed, but
+                # counting it as clean would make the unhealthy threshold
+                # unreachable for a worker failing every prefill
+                self.consec_step_failures = 0
+        return outputs
+
+    def _step_inner(self, outputs: list[StepOutput]) -> None:
         m = self.metrics
+        self._expire_deadlines(outputs)
         pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
         t0 = time.perf_counter() if m else 0.0
         # the speculative paths (n-gram + draft model) force a sync boundary:
@@ -321,7 +396,132 @@ class Scheduler:
                     fetch_wait_s=fetch_s,
                     host_s=max(step_s - fetch_s, 0.0),
                 )
-        return outputs
+
+    # ---- failure isolation (poison-step quarantine) ----
+
+    def _fail_request(
+        self, req: EngineRequest, message: str, outputs: list[StepOutput]
+    ) -> None:
+        """Quarantine one request: fail it with a terminal ``error`` output,
+        releasing its slot, pages, radix locks, and (via ``_release``'s
+        error path) keeping its possibly-poisoned KV OUT of the radix cache.
+        Surviving lanes are untouched."""
+        if req.is_finished:
+            return
+        logger.error("quarantining request %s: %s", req.rid, message)
+        self.num_quarantined += 1
+        if self.metrics is not None:
+            self.metrics.quarantined_requests.inc()
+        if req.status in (RequestStatus.WAITING, RequestStatus.PREEMPTED):
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        finish = FinishInfo(reason="error", message=message)
+        if req.slot is not None:
+            self._release(req, finish)
+        else:
+            req.finish = finish
+            req.status = RequestStatus.FINISHED
+            self._count_finish("error")
+            self.requests.pop(req.rid, None)
+        outputs.append(StepOutput(req, [], True, finish))
+
+    def _count_step_failure(self, phase: str) -> None:
+        self.num_step_failures += 1
+        self.consec_step_failures += 1
+        self._step_had_failure = True
+        if self.metrics is not None:
+            self.metrics.step_failures.labels(phase=phase).inc()
+
+    def _recover_decode_failure(
+        self, outputs: list[StepOutput], exc: Exception
+    ) -> None:
+        """Blame attribution for a decode-phase step failure.
+
+        A decode batch gives no per-row error signal, so blame falls on the
+        MOST-RECENTLY-ADMITTED lane (the newest schedule change is the most
+        likely poison) — it is quarantined, then the surviving lanes get ONE
+        synchronous retry this step.  A second failure condemns the whole
+        batch: every remaining lane is quarantined rather than livelocking
+        the engine on a poison batch.  Any in-flight frame was stashed back
+        on ``self.inflight`` by the raising path, so ``drop_inflight``
+        rewinds its sampling-key fold before the retry refolds."""
+        self.drop_inflight()
+        active = self._decode_active()
+        if not active:
+            # nothing to blame (failure outside the decode batch — e.g. an
+            # admission-bookkeeping bug): surface it WITHOUT counting here;
+            # the engine loop's last-resort handler counts it once as
+            # phase="loop" (counting both would double-step the streak)
+            raise exc
+        self._count_step_failure("decode")
+        logger.exception("decode step failed; attributing blame")
+        newest = max(active, key=lambda t: t[1].sched_serial)[1]
+        self._fail_request(newest, f"decode step failed: {exc}", outputs)
+        if not self._decode_active():
+            return
+        try:
+            self._decode(outputs)
+        except Exception as e2:  # noqa: BLE001 — second strike: condemn batch
+            self._count_step_failure("decode")
+            self.drop_inflight()
+            logger.exception("decode retry failed; quarantining the batch")
+            for _slot, req in self._decode_active():
+                self._fail_request(req, f"decode step failed after retry: {e2}",
+                                   outputs)
+
+    # ---- per-request deadlines ----
+
+    def _expire_deadlines(self, outputs: list[StepOutput]) -> None:
+        """Finish requests past their deadline with reason ``timeout``:
+        WAITING/PREEMPTED requests expire in queue (cheap sweep — they never
+        touched the device), RUNNING/PREFILLING lanes are released exactly
+        like an abort (the overlap pipeline sees the lane vanish and
+        discards its in-flight frame via the staleness check).  No-op when
+        no request carries a deadline, so the fault-free hot path is
+        untouched."""
+        now = time.monotonic()
+        expired_waiting = [
+            r for r in self.waiting
+            if r.deadline is not None and now > r.deadline
+        ]
+        for req in expired_waiting:
+            self.waiting.remove(req)
+            req.status = RequestStatus.FINISHED
+            req.finish = FinishInfo(reason="timeout")
+            self._count_finish("timeout")
+            self.requests.pop(req.rid, None)
+            self.num_deadline_waiting += 1
+            if self.metrics is not None:
+                self.metrics.deadline_expirations.labels(state="waiting").inc()
+            outputs.append(StepOutput(req, [], True, req.finish))
+        for req in list(self.slots):
+            if (
+                req is not None
+                and req.deadline is not None
+                and now > req.deadline
+                and not req.is_finished
+            ):
+                self._release(req, FinishInfo(reason="timeout"))
+                self.num_deadline_running += 1
+                if self.metrics is not None:
+                    self.metrics.deadline_expirations.labels(state="running").inc()
+                outputs.append(StepOutput(req, [], True, req.finish))
+
+    # ---- graceful drain ----
+
+    def drain_waiting(self, outputs: list[StepOutput]) -> None:
+        """Terminate every queued (not yet admitted) request with a terminal
+        ``abort`` output — drain mode finishes admitted work and refuses the
+        rest, and clients must see a terminal chunk, not a hang."""
+        while self.waiting:
+            req = self.waiting.popleft()
+            req.status = RequestStatus.ABORTED
+            req.finish = FinishInfo(reason="abort", message="engine draining")
+            self._count_finish("abort")
+            self.requests.pop(req.rid, None)
+            outputs.append(StepOutput(req, [], True, req.finish))
 
     # ---- overlapped pipeline (one-step lookahead) ----
     #
@@ -370,9 +570,19 @@ class Scheduler:
             # chunk that eats the whole budget, or an empty queue, keeps
             # the lookahead; any possible sampling prefill downgrades one
             # step to the sync path.
-            if self._prefill_phase_fold_free():
-                look = self._launch_lookahead(frame)
-            fetch_s = self._consume_frame(frame, outputs)
+            try:
+                if self._prefill_phase_fold_free():
+                    look = self._launch_lookahead(frame)
+                fetch_s = self._consume_frame(frame, outputs)
+            except Exception:
+                # quarantine path: rewind the NEWEST fold first (the chained
+                # lookahead launched off this frame), then stash the frame on
+                # ``inflight`` so the step-level handler's drop_inflight
+                # rewinds its fold too before the blame/retry refolds
+                if look is not None:
+                    self._discard_frame(look)
+                self.inflight = frame
+                raise
         # The prefill phase runs AFTER the consume so admission sees every
         # slot and page freed by finishes inside the frame — exactly the
         # capacity the sync schedule's admission would see this step.  (Its
@@ -521,6 +731,10 @@ class Scheduler:
         the device.  ``jax.device_get`` is the EXPLICIT materialization of
         the async results — the one intended device→host sync per steady
         -state step, and the form the transfer guard permits."""
+        FAULTS.fire(
+            "engine.device_fetch",
+            rids=",".join(r.rid for _s, r, _e in frame.lanes),
+        )
         t0 = time.perf_counter()
         toks, lps = jax.device_get((frame.toks, frame.lps))
         fetch_s = time.perf_counter() - t0
@@ -555,6 +769,10 @@ class Scheduler:
           free pool (eviction/preemption here would diverge from the sync
           schedule's, which runs AFTER finishes release pages).
         """
+        FAULTS.fire(
+            "engine.decode_step",
+            rids=",".join(r.rid for _s, r, _e in frame.lanes),
+        )
         H = frame.horizon
         ps = self.ps
         max_seq = self.sched.max_seq_len
@@ -654,7 +872,14 @@ class Scheduler:
             remaining = len(req.all_token_ids) - req.prefill_pos
             if remaining <= budget:
                 budget -= remaining
-                self._prefill_final(req, outputs)
+                try:
+                    self._prefill_final(req, outputs)
+                except Exception as e:  # noqa: BLE001 — quarantine boundary
+                    self._count_step_failure("prefill")
+                    self._fail_request(req, f"prefill failed: {e}", outputs)
+                # disturbed either way: on failure we cannot know whether the
+                # key folded before the raise, and a wrongly-kept lookahead
+                # would desync streams — discarding one is the safe cost
                 disturbed = True
             else:
                 if budget < min(self.ps, sched.max_prefill_tokens):
@@ -664,10 +889,14 @@ class Scheduler:
                     # one configured below page_size, so progress is
                     # guaranteed.)
                     break
-                self._prefill_chunk(req, budget)
+                try:
+                    self._prefill_chunk(req, budget)
+                except Exception as e:  # noqa: BLE001 — quarantine boundary
+                    self._count_step_failure("prefill")
+                    self._fail_request(req, f"prefill failed: {e}", outputs)
                 budget = 0
         group: list[EngineRequest] = []
-        while budget > 0 and self.waiting:
+        while budget > 0 and not self.draining and self.waiting:
             got = self._try_admit_head(outputs, budget_left=budget)
             if got is None:
                 break  # no slot, page back-pressure, or sliver-sized leftover
@@ -679,17 +908,43 @@ class Scheduler:
                 budget -= remaining
                 group.append(req)
                 if len(group) >= sched.max_prefill_group:
-                    self._prefill_group(group, outputs)
+                    self._prefill_group_guarded(group, outputs)
                     disturbed = True
                     group = []
             else:
                 # over budget: pack the leftover as the first resumable chunk
-                self._prefill_chunk(req, budget)
+                try:
+                    self._prefill_chunk(req, budget)
+                except Exception as e:  # noqa: BLE001 — quarantine boundary
+                    self._count_step_failure("prefill")
+                    self._fail_request(req, f"prefill failed: {e}", outputs)
                 budget = 0
         if group:
-            self._prefill_group(group, outputs)
+            self._prefill_group_guarded(group, outputs)
             disturbed = True
         return disturbed
+
+    def _prefill_group_guarded(
+        self, group: list[EngineRequest], outputs: list[StepOutput]
+    ) -> None:
+        """Grouped prefill with per-request blame attribution: when the
+        batched call fails, fall back to solo prefills so only the culprit
+        is quarantined and innocent group members still promote this step."""
+        try:
+            self._prefill_group(group, outputs)
+            return
+        except Exception:  # noqa: BLE001 — quarantine boundary
+            self._count_step_failure("prefill")
+            logger.exception(
+                "grouped prefill failed; retrying %d members solo", len(group)
+            )
+        for req in group:
+            if req.is_finished:
+                continue
+            try:
+                self._prefill_final(req, outputs)
+            except Exception as e:  # noqa: BLE001 — the culprit
+                self._fail_request(req, f"prefill failed: {e}", outputs)
 
     def _admit_legacy(self, outputs: list[StepOutput]) -> bool:
         """Drain-the-queue admission (``prefill_mix_policy="throughput"``):
@@ -697,7 +952,7 @@ class Scheduler:
         all their chunks back-to-back — maximal prefill throughput, at the
         cost of stalling decode for the whole drain."""
         disturbed = False
-        while self.waiting:
+        while not self.draining and self.waiting:
             # collect a group of admissible single-chunk prompts; long prompts
             # run solo through the chunk loop
             group: list[EngineRequest] = []
@@ -717,13 +972,17 @@ class Scheduler:
                     # long prompts chunk through the solo loop; short ones
                     # batch — including under serving pp and M-RoPE (the
                     # grouped forward takes pp_mesh + per-row rope ids)
-                    self._prefill_solo(req, prompt, req.cached_tokens, outputs)
+                    try:
+                        self._prefill_solo(req, prompt, req.cached_tokens, outputs)
+                    except Exception as e:  # noqa: BLE001 — quarantine boundary
+                        self._count_step_failure("prefill")
+                        self._fail_request(req, f"prefill failed: {e}", outputs)
                 else:
                     # mm requests batch like text: the group path splices
                     # per-row embeddings (r3 forced them solo — weak #6)
                     group.append(req)
             if group:
-                self._prefill_group(group, outputs)
+                self._prefill_group_guarded(group, outputs)
             if not admitted_any:
                 return disturbed
         return disturbed
@@ -829,6 +1088,7 @@ class Scheduler:
         only, nothing sampled, no key fold (see ``runner.prefill_extend``) —
         which is what lets a lookahead decode frame stay in flight across
         this step."""
+        FAULTS.fire("engine.prefill", rid=req.rid)
         start = req.prefill_pos
         chunk = req.all_token_ids[start : start + take]
         self.runner.prefill_extend(
@@ -850,6 +1110,7 @@ class Scheduler:
         prompt KV, sample the request's first token (this is the prefill key
         fold the overlap pipeline orders lookahead launches after), and
         promote the request to a decode lane."""
+        FAULTS.fire("engine.prefill", rid=req.rid)
         prompt = req.all_token_ids
         start = req.prefill_pos
         chunk = prompt[start:]
@@ -901,6 +1162,7 @@ class Scheduler:
         outputs: list[StepOutput],
     ) -> None:
         """Long prompts: loop chunks under the prefill token budget."""
+        FAULTS.fire("engine.prefill", rid=req.rid)
         row = self.page_tables[req.slot]
         start = matched_tokens
         sp = req.sampling
@@ -1023,6 +1285,10 @@ class Scheduler:
         self, group: list[EngineRequest], outputs: list[StepOutput]
     ) -> None:
         """Batched prefill for a group of single-chunk prompts."""
+        for req in group:
+            # per-member seam BEFORE any bookkeeping mutates, so the guarded
+            # caller's solo fallback sees a clean state for every member
+            FAULTS.fire("engine.prefill", rid=req.rid)
         chunks = []
         g = len(group)
         V = self.runner.model_cfg.vocab_size
@@ -1060,7 +1326,6 @@ class Scheduler:
                 reps[i] = sp.repetition_penalty
             if use_mask and req.token_filter is not None:
                 mask_arr[i] = self._mask_for(req)
-            self.num_prefill_tokens += len(chunk)
         toks, lps = self.runner.prefill_batched(
             chunks, temps, topks, topps, minps,
             pen=(counts, pmask, freqs, pres, reps) if use_pen else None,
@@ -1070,6 +1335,9 @@ class Scheduler:
             rope=rope_rows if any(r is not None for r in rope_rows) else None,
         )
         for i, req in enumerate(group):
+            # counted only after the batched call succeeded (a failed group
+            # re-counts through the solo fallback, never double)
+            self.num_prefill_tokens += len(chunks[i][0])
             req.seq_len = req.total_len
             req.prefill_pos = req.seq_len
             req.status = RequestStatus.RUNNING
@@ -1103,7 +1371,13 @@ class Scheduler:
                 return
         frame = self._launch_frame(active)
         if frame is not None:
-            self._consume_frame(frame, outputs)
+            try:
+                self._consume_frame(frame, outputs)
+            except Exception:
+                # stash so the quarantine handler's drop_inflight rewinds
+                # this frame's sampling-key fold before any retry refolds
+                self.inflight = frame
+                raise
 
     def _refresh_decode_state(
         self, active: list, B: int, mp_b: int,
@@ -1182,6 +1456,9 @@ class Scheduler:
         """Plan + dispatch one decode horizon for ``active`` slots; returns
         the in-flight frame (results unmaterialized) or None when capacity
         pressure evicted every candidate."""
+        FAULTS.fire(
+            "engine.decode_step", rids=",".join(r.rid for _i, r in active)
+        )
         # constrained requests need a fresh host-derived vocab mask per token,
         # so a batch containing one collapses the horizon to single-step
         use_mask = any(r.token_filter is not None for _, r in active)

@@ -37,6 +37,23 @@ class HealthMonitor:
         self._task: asyncio.Task | None = None
         self._fails: dict[str, int] = {}
         self._succs: dict[str, int] = {}
+        # removed workers must not leak monitor state or gauge series: a
+        # churning deployment (k8s discovery, autoscaling) otherwise grows
+        # _fails/_succs and the worker_healthy/worker_load label sets forever
+        registry.on_change(self._on_registry_change)
+
+    def _on_registry_change(self, event: str, worker) -> None:
+        if event != "removed":
+            return
+        wid = worker.worker_id
+        self._fails.pop(wid, None)
+        self._succs.pop(wid, None)
+        if self.metrics is not None:
+            for gauge in (self.metrics.worker_healthy, self.metrics.worker_load):
+                try:
+                    gauge.remove(wid)
+                except KeyError:
+                    pass  # series never emitted for this worker
 
     def start(self) -> None:
         if self._task is None:

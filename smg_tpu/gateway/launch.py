@@ -77,6 +77,8 @@ def build_engine_from_args(args):
             speculative=getattr(args, "speculative", False),
             spec_max_draft=getattr(args, "spec_max_draft", 8),
             overlap_schedule=getattr(args, "overlap_schedule", "on") != "off",
+            max_queued_requests=getattr(args, "max_queued_requests", 0),
+            max_queued_tokens=getattr(args, "max_queued_tokens", 0),
         ),
         model_id=args.model_path or args.model_preset,
         dtype=getattr(args, "dtype", "bfloat16"),
@@ -85,6 +87,7 @@ def build_engine_from_args(args):
         device_metrics_interval_secs=getattr(
             args, "device_metrics_interval_secs", 10.0
         ),
+        step_watchdog_secs=getattr(args, "step_watchdog_secs", 0.0),
     )
     params = None
     vision_params = None
@@ -160,6 +163,9 @@ async def _run_gateway(args) -> int:
         # --no-dp-aware opts into worker-local balancing
         dp_rank_policy=("dp_min_token" if getattr(args, "dp_aware", True)
                        else "dp_passthrough"),
+        # the remaining request budget rides every worker dispatch so the
+        # engine expires abandoned work instead of decoding into the void
+        request_timeout_secs=getattr(args, "request_timeout_secs", None),
     )
     policy_kwargs = {}
     if args.policy == "cache_aware":
@@ -288,6 +294,14 @@ async def _run_gateway(args) -> int:
         GrpcWorkerClient.mm_shm_min_bytes = getattr(
             args, "mm_shm_min_bytes", 1 << 20
         )
+    if getattr(args, "worker_stream_idle_timeout_secs", None) is not None:
+        # process-wide per-chunk idle bound for gRPC generate streams
+        # (0 disables); same class-attr pattern as mm_transport above
+        from smg_tpu.rpc.client import GrpcWorkerClient
+
+        GrpcWorkerClient.idle_timeout_secs = (
+            args.worker_stream_idle_timeout_secs or None
+        )
     if getattr(args, "plugins", None):
         ctx.load_plugins(args.plugins,
                          fail_open=not getattr(args, "plugin_fail_closed", False))
@@ -299,6 +313,9 @@ async def _run_gateway(args) -> int:
         tokenizer = load_tokenizer(args.tokenizer_path or args.model_path)
         ctx.tokenizers.register(engine.config.model_id, tokenizer, default=True)
         client = InProcWorkerClient(engine)
+        client.drain_timeout_secs = getattr(
+            args, "engine_drain_timeout_secs", 10.0
+        )
         ctx.registry.add(
             Worker(
                 worker_id="inproc-0", client=client, model_id=engine.config.model_id,
@@ -449,9 +466,24 @@ async def _run_gateway(args) -> int:
         logger.info("prometheus exporter on %s:%d",
                     getattr(args, "prometheus_host", "0.0.0.0"),
                     args.prometheus_port)
+    # graceful shutdown (reference: the drain-settle path on SIGTERM,
+    # main.rs:550-556): the signal stops SELECTION first (workers flip to
+    # draining so health/readiness report it), then every worker client is
+    # closed — for in-proc engines that is engine.stop(drain=True): queued
+    # requests get terminal aborts and running lanes finish within the
+    # --engine-drain-timeout-secs budget before the process exits
+    stop_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
     try:
-        while True:
-            await asyncio.sleep(3600)
+        import signal as _signal
+
+        for _sig in (_signal.SIGTERM, _signal.SIGINT):
+            loop.add_signal_handler(_sig, stop_event.set)
+    except (NotImplementedError, RuntimeError, ValueError):
+        pass  # non-main thread / platform without signal support
+    try:
+        await stop_event.wait()
+        logger.info("shutdown signal received; draining workers")
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
@@ -459,6 +491,14 @@ async def _run_gateway(args) -> int:
             await d.aclose()
         if mesh_node is not None:
             await mesh_node.stop()
+        for w in ctx.registry.list():
+            w.draining = True  # no new selections while streams settle
+        for w in ctx.registry.list():
+            try:
+                await w.client.close()
+            except Exception:
+                logger.exception("worker %s close failed during shutdown",
+                                 w.worker_id)
         if metrics_runner is not None:
             await metrics_runner.cleanup()
         if probe_runner is not None:

@@ -19,7 +19,11 @@ from dataclasses import dataclass, field
 from smg_tpu.engine.detokenize import IncrementalDecoder, StopStringChecker
 from smg_tpu.gateway.observability import current_route
 from smg_tpu.gateway.tracing import end_stage, stage, start_stage
-from smg_tpu.gateway.worker_client import WorkerGenerateRequest, WorkerStreamChunk
+from smg_tpu.gateway.worker_client import (
+    WorkerGenerateRequest,
+    WorkerQueueFullError,
+    WorkerStreamChunk,
+)
 from smg_tpu.gateway.workers import Worker, WorkerRegistry
 from smg_tpu.policies import PolicyRegistry, RequestContext
 from smg_tpu.protocols.openai import (
@@ -71,6 +75,11 @@ class RouterConfig:
     # the replica with the fewest outstanding tokens; "dp_passthrough" lets
     # the worker balance locally (reference: dp_min_token.rs:24-31)
     dp_rank_policy: str = "dp_min_token"
+    # gateway --request-timeout-secs: the REMAINING budget rides each worker
+    # dispatch (WorkerGenerateRequest.timeout_secs -> engine deadline), so a
+    # request the HTTP layer would abandon also stops consuming engine slots
+    # and pages — and a retry carries only what is left, not a fresh budget
+    request_timeout_secs: float | None = None
 
 
 @dataclass
@@ -308,13 +317,31 @@ class Router:
 
         attempts = 0
         exclude: set[str] = set(mm_exclude)
+        saw_queue_full = False
         # dp-rank cost estimate: prompt + generation budget (released on exit)
         dp_cost = len(input_ids) + (worker_sampling.max_new_tokens or 0)
         # TTFT is attributed from dispatch start: worker selection + engine
         # queue + prefill, across retries (tokenize happened upstream)
         t_dispatch = time.perf_counter()
+        # remaining-budget deadline for --request-timeout-secs propagation:
+        # each (re)dispatch hands the engine only what is left
+        budget_deadline = (
+            time.monotonic() + self.config.request_timeout_secs
+            if self.config.request_timeout_secs
+            else None
+        )
         while True:
-            worker = self.select_worker(ctx, exclude=exclude)
+            try:
+                worker = self.select_worker(ctx, exclude=exclude)
+            except RouteError:
+                if saw_queue_full:
+                    # every candidate rejected with backpressure: the honest
+                    # front-door answer is 429 retry-later, not a 5xx
+                    raise RouteError(
+                        429, "all workers at capacity; retry later",
+                        "rate_limit_error",
+                    ) from None
+                raise
             guard = worker.acquire()
             got_first_chunk = False
             finished_cleanly = False
@@ -351,6 +378,11 @@ class Router:
                     rid=rid, input_ids=input_ids, sampling=worker_sampling,
                     data_parallel_rank=-1 if dp_rank is None else dp_rank,
                     mm_embeds=mm,
+                    timeout_secs=(
+                        max(budget_deadline - time.monotonic(), 0.0)
+                        if budget_deadline is not None
+                        else None
+                    ),
                 )
                 async for chunk in worker.client.generate(wreq):
                     if not got_first_chunk and prefill_span is not None:
@@ -404,6 +436,27 @@ class Router:
                 except Exception:
                     pass
                 raise
+            except WorkerQueueFullError as e:
+                # admission backpressure: retry another worker WITHOUT
+                # penalizing this one's breaker (a full queue is load, not
+                # fault — opening the circuit would shrink capacity exactly
+                # when it is most needed)
+                guard.release(success=None)
+                saw_queue_full = True
+                attempts += 1
+                exclude.add(worker.worker_id)
+                if attempts > max(self.config.max_retries, 1):
+                    raise RouteError(
+                        429, "all workers at capacity; retry later",
+                        "rate_limit_error",
+                    )
+                if self.metrics is not None:
+                    self.metrics.retries_total.inc()
+                logger.warning(
+                    "worker %s rejected %s with queue-full; trying another",
+                    worker.worker_id, rid,
+                )
+                _close_spans(error=True)
             except Exception as e:
                 guard.release(success=False)
                 attempts += 1

@@ -18,6 +18,13 @@ from typing import AsyncIterator
 from smg_tpu.protocols.sampling import SamplingParams
 
 
+class WorkerQueueFullError(RuntimeError):
+    """The worker rejected the request with admission backpressure (engine
+    bounded-queue ``QueueFullError`` / gRPC RESOURCE_EXHAUSTED).  Retryable:
+    the router tries another worker without penalizing this one's circuit
+    breaker, and answers 429 when every candidate is saturated."""
+
+
 @dataclass
 class WorkerGenerateRequest:
     rid: str
@@ -31,6 +38,11 @@ class WorkerGenerateRequest:
     # embeddings replacing the image placeholder tokens at ``positions``
     # (reference: the EPD encode leg's output riding the prefill dispatch)
     mm_embeds: tuple | None = None
+    # remaining client budget in seconds (gateway --request-timeout-secs
+    # minus time already spent): the engine expires the request in queue or
+    # aborts it mid-generation with finish_reason="timeout".  None = no
+    # deadline.
+    timeout_secs: float | None = None
 
 
 @dataclass
@@ -141,11 +153,18 @@ class InProcWorkerClient(WorkerClient):
 
     supports_device_kv = True
 
+    #: drain budget handed to ``engine.stop(drain=True)`` on close — long
+    #: enough for in-flight lanes to finish, short enough for prompt SIGTERM
+    drain_timeout_secs: float = 10.0
+
     def __init__(self, engine):
         self.engine = engine
         engine.start()
 
     async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
+        from smg_tpu.engine.request import QueueFullError
+        from smg_tpu.faults import FAULTS
+
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -163,12 +182,20 @@ class InProcWorkerClient(WorkerClient):
             )
             loop.call_soon_threadsafe(q.put_nowait, chunk)
 
-        self.engine.submit(
-            req.input_ids, req.sampling, rid=req.rid, on_output=on_output,
-            mm_embeds=req.mm_embeds,
-        )
+        try:
+            self.engine.submit(
+                req.input_ids, req.sampling, rid=req.rid, on_output=on_output,
+                mm_embeds=req.mm_embeds, timeout_secs=req.timeout_secs,
+            )
+        except QueueFullError as e:
+            # transport-level shape of engine backpressure: the router
+            # retries another worker / answers 429, breaker untouched
+            raise WorkerQueueFullError(str(e)) from e
         while True:
             chunk = await q.get()
+            # fault point: simulated transport death mid-stream (the
+            # reliability suite's worker-crash scenarios fire here)
+            FAULTS.fire("worker.stream", rid=req.rid)
             yield chunk
             if chunk.finished:
                 return
@@ -234,7 +261,10 @@ class InProcWorkerClient(WorkerClient):
                 return
 
     async def health(self) -> bool:
-        return True
+        # engine-level health, not liveness: a wedged device fetch or a run
+        # of consecutive step failures reports false here, so HealthMonitor
+        # and breakers route around the worker while it recovers
+        return bool(getattr(self.engine, "healthy", True))
 
     async def get_loads(self) -> dict:
         # includes engine-deep stats: cached/computed prompt tokens,
@@ -325,4 +355,9 @@ class InProcWorkerClient(WorkerClient):
         return self.engine.events.subscribe(callback)
 
     async def close(self) -> None:
-        self.engine.stop()
+        # graceful by default: admission stops, queued requests get terminal
+        # aborts, running lanes finish (bounded); off the event loop — the
+        # drain wait is seconds of blocking
+        await asyncio.to_thread(
+            self.engine.stop, True, self.drain_timeout_secs
+        )

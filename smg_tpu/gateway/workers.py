@@ -55,25 +55,62 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._consecutive_successes = 0
         self._opened_at = 0.0
+        # HALF_OPEN probe gate: one in-flight probe at a time.  Without it a
+        # cooldown expiry under load floods the possibly-still-sick worker
+        # with the entire backed-up queue at once (half-open flood).  The
+        # slot is claimed at DISPATCH (``begin_probe`` from the load guard),
+        # not in ``allow()`` — availability checks (health endpoints, policy
+        # filters that pick another worker) must stay read-only or they
+        # would starve real probes.  The timestamp self-heals a probe whose
+        # outcome never lands (client vanished before record_*).
+        self._probe_started: float | None = None
         self._lock = threading.Lock()
+
+    def _state_locked(self) -> CircuitState:
+        if (
+            self._state == CircuitState.OPEN
+            and time.monotonic() - self._opened_at >= self.cooldown_secs
+        ):
+            self._state = CircuitState.HALF_OPEN
+            self._consecutive_successes = 0
+            self._probe_started = None
+        return self._state
 
     @property
     def state(self) -> CircuitState:
         with self._lock:
-            if (
-                self._state == CircuitState.OPEN
-                and time.monotonic() - self._opened_at >= self.cooldown_secs
-            ):
-                self._state = CircuitState.HALF_OPEN
-                self._consecutive_successes = 0
-            return self._state
+            return self._state_locked()
 
     def allow(self) -> bool:
-        return self.state != CircuitState.OPEN
+        """Read-only admission check (no state consumed — safe for health
+        endpoints and policy filters): OPEN denies, HALF_OPEN denies while a
+        probe is already in flight."""
+        with self._lock:
+            st = self._state_locked()
+            if st == CircuitState.OPEN:
+                return False
+            if st == CircuitState.HALF_OPEN:
+                now = time.monotonic()
+                if (
+                    self._probe_started is not None
+                    and now - self._probe_started < self.cooldown_secs
+                ):
+                    return False  # a probe is already in flight
+            return True
+
+    def begin_probe(self) -> None:
+        """Claim the HALF_OPEN probe slot (called when a request actually
+        dispatches).  The check-then-claim race with ``allow()`` can at
+        worst let a second probe slip through — bounded, unlike the
+        unbounded half-open flood this replaces."""
+        with self._lock:
+            if self._state_locked() == CircuitState.HALF_OPEN:
+                self._probe_started = time.monotonic()
 
     def record_success(self) -> None:
         with self._lock:
             self._consecutive_failures = 0
+            self._probe_started = None
             if self._state == CircuitState.HALF_OPEN:
                 self._consecutive_successes += 1
                 if self._consecutive_successes >= self.success_threshold:
@@ -87,6 +124,7 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_successes = 0
             self._consecutive_failures += 1
+            self._probe_started = None
             if self._state == CircuitState.HALF_OPEN or (
                 self._state == CircuitState.CLOSED
                 and self._consecutive_failures >= self.failure_threshold
@@ -159,6 +197,12 @@ class Worker:
         with self._lock:
             self._load = max(0, self._load - 1)
 
+    def _record_failure(self) -> None:
+        # under the worker lock: total_failures is read by describe()/tests
+        # from other threads, and += on a shared int is not atomic
+        with self._lock:
+            self.total_failures += 1
+
     def describe(self) -> dict:
         return {
             "worker_id": self.worker_id,
@@ -181,18 +225,24 @@ class WorkerLoadGuard:
     def __init__(self, worker: Worker):
         self.worker = worker
         self._released = False
+        worker.circuit.begin_probe()  # half-open: this dispatch IS the probe
         worker._inc()
 
-    def release(self, success: bool = True) -> None:
+    def release(self, success: "bool | None" = True) -> None:
+        """Release once.  ``success=None`` releases the load WITHOUT a
+        breaker signal — for outcomes that are neither success nor worker
+        fault (admission backpressure: the worker is healthy, just full)."""
         if self._released:
             return
         self._released = True
         self.worker._dec()
+        if success is None:
+            return
         if success:
             self.worker.circuit.record_success()
         else:
             self.worker.circuit.record_failure()
-            self.worker.total_failures += 1
+            self.worker._record_failure()
 
     def __enter__(self):
         return self

@@ -15,6 +15,7 @@ import grpc.aio
 from smg_tpu.gateway.worker_client import (
     WorkerClient,
     WorkerGenerateRequest,
+    WorkerQueueFullError,
     WorkerStreamChunk,
 )
 from smg_tpu.rpc import method
@@ -29,7 +30,66 @@ from smg_tpu.utils import get_logger
 logger = get_logger("rpc.client")
 
 
+class StreamIdleTimeout(RuntimeError):
+    """No chunk arrived within the idle window: treated as a worker failure
+    so the router's retry/breaker path engages (a stream that stops making
+    progress is indistinguishable from a dead worker)."""
+
+
+async def iter_with_idle_timeout(
+    call,
+    idle_timeout_secs: float | None,
+    url: str,
+    first_chunk_timeout_secs: float | None = None,
+):
+    """Yield chunks from a gRPC stream, bounding the INTER-chunk gap.
+
+    Replaces the old whole-stream 600s cap, which both killed legitimate
+    long generations and let a silently-wedged worker hold a client for ten
+    minutes.  A healthy stream emits a chunk every engine step once decoding
+    starts, so mid-stream silence of ``idle_timeout_secs`` is a worker
+    fault.  The FIRST chunk legitimately waits behind the worker's queue +
+    prefill — bounding it with the idle window would record merely-busy
+    workers as breaker failures at peak load — so it gets the separate
+    (longer) ``first_chunk_timeout_secs`` wedge backstop.  ``None``/0
+    disables either bound."""
+    it = call.__aiter__()
+    bound = first_chunk_timeout_secs
+    while True:
+        try:
+            if bound and bound > 0:
+                chunk = await asyncio.wait_for(it.__anext__(), bound)
+            else:
+                chunk = await it.__anext__()
+        except StopAsyncIteration:
+            return
+        except asyncio.TimeoutError:
+            call.cancel()
+            raise StreamIdleTimeout(
+                f"worker {url}: no stream chunk for {bound:.0f}s"
+            ) from None
+        bound = idle_timeout_secs
+        yield chunk
+
+
 class GrpcWorkerClient(WorkerClient):
+    #: inter-chunk idle bound on generate streams (seconds; None/0
+    #: disables).  Class-level so ``--worker-stream-idle-timeout-secs``
+    #: configures every client the gateway dials (same pattern as
+    #: ``mm_transport``).
+    idle_timeout_secs: "float | None" = 120.0
+    #: wedge backstop for the FIRST chunk only (queue wait + prefill are
+    #: legitimate latency, not silence — see iter_with_idle_timeout)
+    first_chunk_timeout_secs: "float | None" = 600.0
+    #: per-call timeouts, threaded from config instead of scattered
+    #: literals: ``unary`` covers hot control-plane calls (health / abort /
+    #: loads), ``setup`` covers registration-time metadata (model info,
+    #: flush, adapter list, profile start), ``bulk`` covers payload-heavy
+    #: calls (embed, encode, prefill export, tokenizer/LoRA transfer)
+    unary_timeout_secs: float = 5.0
+    setup_timeout_secs: float = 30.0
+    bulk_timeout_secs: float = 600.0
+
     def __init__(self, url: str):
         if "://" in url:
             url = url.split("://", 1)[1]
@@ -141,17 +201,26 @@ class GrpcWorkerClient(WorkerClient):
         self._kv_tasks: list[asyncio.Task] = []
 
     async def generate(self, req: WorkerGenerateRequest) -> AsyncIterator[WorkerStreamChunk]:
+        # proto sentinel: 0 = "no deadline", so an EXHAUSTED budget (0.0s
+        # remaining after retries ate it) must round up to a tiny positive
+        # value — sending 0.0 verbatim would invert "expired" into
+        # "unlimited" on the worker
+        budget = getattr(req, "timeout_secs", None)
         msg = pb.GenerateRequestProto(
             rid=req.rid, input_ids=req.input_ids,
             sampling=sampling_to_proto(req.sampling),
             data_parallel_rank=req.data_parallel_rank,
+            timeout_secs=0.0 if budget is None else max(budget, 1e-3),
         )
         mm = mm_embeds_to_proto(getattr(req, "mm_embeds", None))
         if mm is not None:
             msg.mm_embeds.CopyFrom(mm)
         call = self._generate(msg)
         try:
-            async for chunk in call:
+            async for chunk in iter_with_idle_timeout(
+                call, self.idle_timeout_secs, self.url,
+                first_chunk_timeout_secs=self.first_chunk_timeout_secs,
+            ):
                 if chunk.error:
                     raise RuntimeError(f"worker error: {chunk.error}")
                 yield WorkerStreamChunk(
@@ -167,6 +236,12 @@ class GrpcWorkerClient(WorkerClient):
                     cached_tokens=chunk.cached_tokens,
                     output_tokens=chunk.output_tokens,
                 )
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # engine admission backpressure: retryable-elsewhere, not a
+                # worker fault (the router leaves the breaker alone)
+                raise WorkerQueueFullError(e.details() or "worker queue full") from e
+            raise
         finally:
             call.cancel()
 
@@ -187,7 +262,7 @@ class GrpcWorkerClient(WorkerClient):
                 rid="prefill", input_ids=input_ids,
                 sampling=sampling_to_proto(sampling), connector=connector,
             ),
-            timeout=600,
+            timeout=self.bulk_timeout_secs,
         )
         if resp.error:
             raise RuntimeError(f"prefill export error: {resp.error}")
@@ -233,7 +308,10 @@ class GrpcWorkerClient(WorkerClient):
             msg.kv_dtype = str(k.dtype)
         call = self._generate_prefilled(msg)
         try:
-            async for chunk in call:
+            async for chunk in iter_with_idle_timeout(
+                call, self.idle_timeout_secs, self.url,
+                first_chunk_timeout_secs=self.first_chunk_timeout_secs,
+            ):
                 if chunk.error:
                     raise RuntimeError(f"worker error: {chunk.error}")
                 yield WorkerStreamChunk(
@@ -257,7 +335,7 @@ class GrpcWorkerClient(WorkerClient):
         req = pb.EmbedBatchRequestProto(rid="embed")
         for ids in batches:
             req.inputs.add(ids=ids)
-        resp = await self._embed_batch(req, timeout=300)
+        resp = await self._embed_batch(req, timeout=self.bulk_timeout_secs)
         if resp.error:
             raise RuntimeError(f"worker embed error: {resp.error}")
         return [list(v.values) for v in resp.embeddings]
@@ -302,7 +380,7 @@ class GrpcWorkerClient(WorkerClient):
         if shm is None:
             msg.pixel_values = pixels.tobytes()
         try:
-            resp = await self._encode(msg, timeout=300)
+            resp = await self._encode(msg, timeout=self.bulk_timeout_secs)
             if (shm is not None and resp.error
                     and resp.error.startswith("shm_unavailable")):
                 # loopback address but no shared /dev/shm (worker in a
@@ -313,7 +391,7 @@ class GrpcWorkerClient(WorkerClient):
                 )
                 msg.shm_name = ""
                 msg.pixel_values = pixels.tobytes()
-                resp = await self._encode(msg, timeout=300)
+                resp = await self._encode(msg, timeout=self.bulk_timeout_secs)
         finally:
             if shm is not None:
                 shm.close()
@@ -330,7 +408,8 @@ class GrpcWorkerClient(WorkerClient):
     async def release_kv_offer(self, uuid: int, consumed: bool) -> bool:
         try:
             resp = await self._release_kv_offer(
-                pb.KvOfferProto(uuid=int(uuid), consumed=consumed), timeout=10
+                pb.KvOfferProto(uuid=int(uuid), consumed=consumed),
+                timeout=self.setup_timeout_secs,
             )
             return resp.ok
         except grpc.aio.AioRpcError:
@@ -338,20 +417,22 @@ class GrpcWorkerClient(WorkerClient):
 
     async def abort(self, rid: str) -> bool:
         try:
-            resp = await self._abort(pb.AbortRequestProto(rid=rid), timeout=5)
+            resp = await self._abort(
+                pb.AbortRequestProto(rid=rid), timeout=self.unary_timeout_secs
+            )
             return resp.ok
         except grpc.aio.AioRpcError:
             return False
 
     async def health(self) -> bool:
         try:
-            resp = await self._health(pb.EmptyProto(), timeout=5)
+            resp = await self._health(pb.EmptyProto(), timeout=self.unary_timeout_secs)
             return resp.ok
         except grpc.aio.AioRpcError:
             return False
 
     async def get_loads(self) -> dict:
-        resp = await self._get_loads(pb.EmptyProto(), timeout=5)
+        resp = await self._get_loads(pb.EmptyProto(), timeout=self.unary_timeout_secs)
         return {
             "num_waiting": resp.num_waiting,
             "num_running": resp.num_running,
@@ -362,7 +443,7 @@ class GrpcWorkerClient(WorkerClient):
         }
 
     async def get_model_info(self) -> dict:
-        resp = await self._model_info(pb.EmptyProto(), timeout=10)
+        resp = await self._model_info(pb.EmptyProto(), timeout=self.setup_timeout_secs)
         info = {
             "model_id": resp.model_id,
             "max_seq_len": resp.max_seq_len,
@@ -382,7 +463,7 @@ class GrpcWorkerClient(WorkerClient):
         return info
 
     async def flush_cache(self) -> bool:
-        resp = await self._flush(pb.EmptyProto(), timeout=30)
+        resp = await self._flush(pb.EmptyProto(), timeout=self.setup_timeout_secs)
         return resp.ok
 
     async def load_lora_adapter(
@@ -390,18 +471,18 @@ class GrpcWorkerClient(WorkerClient):
     ) -> dict:
         resp = await self._load_lora(
             pb.LoadLoraRequestProto(name=name, path=path or "", npz=data or b""),
-            timeout=300,
+            timeout=self.bulk_timeout_secs,
         )
         return {"ok": resp.ok, "error": resp.error, "slot": resp.slot}
 
     async def unload_lora_adapter(self, name: str) -> dict:
         resp = await self._unload_lora(
-            pb.LoadLoraRequestProto(name=name), timeout=60
+            pb.LoadLoraRequestProto(name=name), timeout=self.setup_timeout_secs
         )
         return {"ok": resp.ok, "error": resp.error}
 
     async def list_lora_adapters(self) -> list[str]:
-        resp = await self._list_lora(pb.EmptyProto(), timeout=30)
+        resp = await self._list_lora(pb.EmptyProto(), timeout=self.setup_timeout_secs)
         return list(resp.names)
 
     async def get_tokenizer(self):
@@ -410,7 +491,9 @@ class GrpcWorkerClient(WorkerClient):
 
         parts: list[bytes] = []
         fmt = sha = ""
-        async for chunk in self._get_tokenizer(pb.EmptyProto(), timeout=300):
+        async for chunk in self._get_tokenizer(
+            pb.EmptyProto(), timeout=self.bulk_timeout_secs
+        ):
             if chunk.data:
                 parts.append(chunk.data)
             if chunk.last:
@@ -430,12 +513,14 @@ class GrpcWorkerClient(WorkerClient):
                 python_tracer=python_tracer,
                 num_steps=num_steps,
             ),
-            timeout=30,
+            timeout=self.setup_timeout_secs,
         )
         return {"ok": resp.ok, "error": resp.error, "output_dir": resp.output_dir}
 
     async def stop_profile(self) -> dict:
-        resp = await self._stop_profile(pb.EmptyProto(), timeout=60)
+        resp = await self._stop_profile(
+            pb.EmptyProto(), timeout=self.setup_timeout_secs
+        )
         return {"ok": resp.ok, "error": resp.error}
 
     def subscribe_kv_events(self, callback):

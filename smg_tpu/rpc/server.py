@@ -59,6 +59,9 @@ class SchedulerServicer:
         return min(self.engines, key=lambda e: e.loads()["queued_tokens"])
 
     async def Generate(self, request: pb.GenerateRequestProto, context):
+        from smg_tpu.engine.request import QueueFullError
+        from smg_tpu.faults import FAULTS
+
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         sampling = sampling_from_proto(request.sampling)
@@ -67,13 +70,21 @@ class SchedulerServicer:
             loop.call_soon_threadsafe(q.put_nowait, out)
 
         rid = request.rid
+        # fault point: worker-side RPC failure before any engine state is
+        # touched (the reliability suite's retry/breaker scenarios fire here)
+        FAULTS.fire("rpc.generate", rid=rid)
         try:
             engine = self._engine_for(request.data_parallel_rank)
             engine.submit(
                 list(request.input_ids), sampling, rid=rid,
                 on_output=on_output, priority=request.priority,
                 mm_embeds=mm_embeds_from_proto(request.mm_embeds),
+                timeout_secs=request.timeout_secs or None,
             )
+        except QueueFullError as e:
+            # admission backpressure is RETRYABLE, not a request error: a
+            # status the client maps to try-another-worker / HTTP 429
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except ValueError as e:
             # invalid sampling config (e.g. unsupported regex/ebnf constraint):
             # structured terminal chunk, mirroring the sibling handlers
@@ -286,7 +297,10 @@ class SchedulerServicer:
         return pb.AbortResponseProto(ok=ok)
 
     async def HealthCheck(self, request: pb.EmptyProto, context):
-        return pb.HealthResponseProto(ok=True)
+        # real engine health, not process liveness: a wedged or repeatedly-
+        # failing engine answers not-ok so the gateway routes around it
+        ok = all(getattr(e, "healthy", True) for e in self.engines)
+        return pb.HealthResponseProto(ok=ok)
 
     async def GetLoads(self, request: pb.EmptyProto, context):
         per_rank = [e.loads() for e in self.engines]

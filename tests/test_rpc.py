@@ -315,3 +315,110 @@ def test_dp_load_manager_and_min_token_policy():
     mgr = DpLoadManager()
     mgr.seed("w3", [1000, 0])
     assert mgr.select_and_increment_lowest("w3", 2, 10) == 1
+
+
+# ---- failure isolation over the wire (ISSUE 5) ----
+
+
+def test_deadline_rides_the_proto_and_times_out(rpc):
+    """WorkerGenerateRequest.timeout_secs -> GenerateRequestProto ->
+    engine deadline: an exhausted budget comes back as a terminal
+    finish_reason='timeout' chunk, not a hung stream."""
+    async def go():
+        req = WorkerGenerateRequest(
+            rid="deadline-1", input_ids=list(range(5, 25)),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=100_000,
+                                    ignore_eos=True),
+            timeout_secs=0.05,
+        )
+        chunks = []
+        async for chunk in rpc.client.generate(req):
+            chunks.append(chunk)
+        return chunks
+
+    chunks = rpc.run(go())
+    assert chunks[-1].finished
+    assert chunks[-1].finish_reason == "timeout"
+
+
+def test_queue_full_maps_to_resource_exhausted(rpc):
+    """Engine QueueFullError -> gRPC RESOURCE_EXHAUSTED -> client
+    WorkerQueueFullError (the retryable shape the router keys off)."""
+    from smg_tpu.gateway.worker_client import WorkerQueueFullError
+
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=4, max_seq_len=128, max_prefill_tokens=64,
+            prefill_token_buckets=(32, 64), decode_batch_buckets=(4,),
+            max_queued_requests=1,
+        ),
+        dtype="float32", model_id="tiny-rpc-full",
+    )
+    engine = Engine(cfg)
+    # never started + full queue: every submit rejects deterministically
+    engine.submit([1, 2, 3], SamplingParams(max_new_tokens=2), rid="filler")
+
+    async def setup():
+        from smg_tpu.rpc.server import serve_worker_async
+
+        server = await serve_worker_async(engine, port=0, host="127.0.0.1")
+        client = GrpcWorkerClient(f"127.0.0.1:{server._bound_port}")
+        return server, client
+
+    server, client = rpc.run(setup())
+    try:
+        async def go():
+            req = WorkerGenerateRequest(
+                rid="q1", input_ids=[4, 5, 6],
+                sampling=SamplingParams(max_new_tokens=2),
+            )
+            async for _ in client.generate(req):
+                pass
+
+        with pytest.raises(WorkerQueueFullError):
+            rpc.run(go())
+    finally:
+        rpc.run(client.close())
+        rpc.run(server.stop(grace=None))
+        engine.stop()
+
+
+def test_rpc_generate_fault_point_surfaces_as_rpc_error(rpc):
+    """The rpc.generate fault seam kills the stream with a gRPC error (the
+    shape a crashed servicer produces), and the next request is clean."""
+    from smg_tpu.faults import FAULTS
+
+    def gen(rid):
+        async def go():
+            req = WorkerGenerateRequest(
+                rid=rid, input_ids=list(range(5, 15)),
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=2,
+                                        ignore_eos=True),
+            )
+            return [c async for c in rpc.client.generate(req)]
+        return go
+
+    FAULTS.arm("rpc.generate", mode="once")
+    try:
+        with pytest.raises(Exception):
+            rpc.run(gen("faulted")())
+    finally:
+        FAULTS.clear()
+    chunks = rpc.run(gen("clean-after")())
+    assert chunks[-1].finished
+
+
+def test_health_reflects_engine_health(rpc):
+    """HealthCheck answers from engine state: consecutive step failures
+    flip it false, recovery flips it back."""
+    eng = rpc.engine
+    threshold = eng.config.max_consecutive_step_failures
+    eng.scheduler.consec_step_failures = threshold
+    try:
+        assert rpc.run(rpc.client.health()) is False
+    finally:
+        eng.scheduler.consec_step_failures = 0
+    assert rpc.run(rpc.client.health()) is True
