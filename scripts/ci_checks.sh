@@ -17,7 +17,12 @@
 #                       smg_tpu/faults.py fault points: poison-step
 #                       quarantine (survivor byte-parity + zero leaks),
 #                       deadlines, backpressure, watchdog, drain
-#                       (tests/test_reliability.py).
+#                       (tests/test_reliability.py);
+#   6. flight recorder — step-level black box + SLO accounting: ring-bound
+#                       under churn, dump-on-quarantine/watchdog/health-flip/
+#                       drain via faults.py, DumpFlight RPC + /debug/flight
+#                       end-to-end, TTFT failover attribution, /debug/slo
+#                       (tests/test_flight_recorder.py).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -39,6 +44,10 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chunked_prefill.py \
 
 echo "== reliability / failure isolation =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_reliability.py -q \
+    -m 'not slow' -p no:cacheprovider
+
+echo "== flight recorder / SLO accounting =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_flight_recorder.py -q \
     -m 'not slow' -p no:cacheprovider
 
 echo "ci_checks: all green"

@@ -286,6 +286,24 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                    dest="device_metrics_interval_secs",
                    help="cadence for HBM gauges from device.memory_stats() "
                         "(0 disables device sampling)")
+    g.add_argument("--flight-recorder", default="on", choices=["on", "off"],
+                   dest="flight_recorder",
+                   help="engine flight recorder: bounded per-step ring + "
+                        "per-request timelines, auto-dumped on quarantine/"
+                        "watchdog/health-flip/drain and fetchable via "
+                        "GET /debug/flight/{worker}; 'off' only for A/B "
+                        "overhead benches")
+    g.add_argument("--flight-ring-size", type=int, default=256,
+                   dest="flight_ring_size",
+                   help="steps kept in the flight-recorder ring buffer")
+    g.add_argument("--flight-dump-dir", default=None, dest="flight_dump_dir",
+                   help="directory for reason-tagged flight-dump JSON files "
+                        "(default: keep the last dumps in memory only, "
+                        "fetchable over the DumpFlight RPC)")
+    g.add_argument("--flight-dump-min-interval-secs", type=float, default=5.0,
+                   dest="flight_dump_min_interval_secs",
+                   help="per-reason rate limit between automatic flight "
+                        "dumps")
 
 
 def main(argv: list[str] | None = None) -> int:

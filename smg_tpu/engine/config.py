@@ -187,6 +187,21 @@ class EngineConfig:
     # and the RPC health() go false so HealthMonitor + circuit breakers route
     # around the worker while it keeps retrying.
     max_consecutive_step_failures: int = 3
+    # ---- flight recorder (engine/flight_recorder.py) ----
+    # always-on step-level black box: a bounded ring of per-step records plus
+    # per-request timelines, auto-dumped as JSON on quarantine / watchdog
+    # stall / health flip / drain and fetchable via Engine.dump_flight() ->
+    # DumpFlight RPC -> GET /debug/flight/{worker}.  Host-side metadata only
+    # (never forces a device sync); disable only for A/B overhead benches.
+    flight_recorder: bool = True
+    flight_ring_size: int = 256
+    flight_timeline_keep: int = 64
+    # dump destination: None keeps the last dumps in memory (fetchable over
+    # RPC); a directory additionally writes reason-tagged JSON files
+    flight_dump_dir: str | None = None
+    # per-reason dump rate limit (a quarantine storm produces one dump per
+    # interval, not one per poisoned request)
+    flight_dump_min_interval_secs: float = 5.0
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

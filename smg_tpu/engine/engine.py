@@ -145,15 +145,19 @@ class Engine:
         priority: int = 0,
         mm_embeds: tuple | None = None,  # (embeds [M, E] f32, positions [M])
         timeout_secs: float | None = None,
+        trace_id: str | None = None,
     ) -> str:
         """Queue a request.  ``timeout_secs`` is the remaining client budget:
         the scheduler expires it in queue or aborts it mid-generation with a
         terminal ``timeout`` finish once the budget runs out.  Raises
-        ``QueueFullError`` (retryable) under admission backpressure."""
+        ``QueueFullError`` (retryable) under admission backpressure.
+        ``trace_id`` links the flight-recorder timeline to the gateway's
+        OTel trace (propagated over the worker hop as gRPC metadata)."""
         rid = rid or f"req-{uuid.uuid4().hex[:16]}"
         req = EngineRequest(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling, priority=priority
         )
+        req.trace_id = trace_id
         if timeout_secs is not None:
             # an exhausted budget (<= 0) still submits: the first sweep
             # returns the terminal "timeout" through the normal output path
@@ -285,6 +289,37 @@ class Engine:
         out["healthy"] = self.healthy
         out["watchdog_stalls"] = self.num_watchdog_stalls
         return out
+
+    def dump_flight(self, reason: str = "manual") -> dict:
+        """Flight-recorder snapshot: the per-step ring, per-request
+        timelines, and the index of auto-dumps (postmortem black box;
+        ``DumpFlight`` RPC / ``GET /debug/flight/{worker}`` land here).
+
+        Deliberately does NOT take the engine lock: a wedged step thread
+        (the very situation a postmortem is for) holds that lock, and the
+        recorder is internally consistent under its own small lock."""
+        fl = self.scheduler.flight
+        if fl is None:
+            from smg_tpu.engine.flight_recorder import SCHEMA_VERSION
+
+            return {
+                "schema_version": SCHEMA_VERSION,
+                "error": "flight recorder disabled",
+            }
+        snap = fl.snapshot(reason)
+        snap["engine"] = {
+            "model_id": self.config.model_id,
+            "healthy": self.healthy,
+            "uptime_secs": time.monotonic() - self.start_time,
+            "watchdog_stalls": self.num_watchdog_stalls,
+            "consecutive_step_failures": self.scheduler.consec_step_failures,
+            "draining": self.scheduler.draining,
+        }
+        if fl.dumps:
+            # the newest auto-dump rides along in full so one fetch answers
+            # "what did the black box capture when it tripped"
+            snap["last_auto_dump"] = fl.dumps[-1]
+        return snap
 
     def flush_cache(self) -> bool:
         with self._lock:
@@ -469,12 +504,14 @@ class Engine:
         sampling: SamplingParams,
         rid: str | None = None,
         on_output=None,
+        trace_id: str | None = None,
     ) -> str:
         """Decode leg: import prompt KV, adopt the request, continue decoding.
         Falls back to a normal (re-prefilling) submission when no slot/pages
         are available."""
         rid = rid or f"req-{uuid.uuid4().hex[:16]}"
         req = EngineRequest(rid=rid, prompt_ids=list(prompt_ids), sampling=sampling)
+        req.trace_id = trace_id
         if self.tokenizer is not None:
             req.detok = IncrementalDecoder(
                 self.tokenizer, skip_special_tokens=sampling.skip_special_tokens
@@ -648,6 +685,11 @@ class Engine:
         admitted lanes (RUNNING and mid-prefill) to finish streaming before
         the loop is torn down."""
         if drain:
+            fl = self.scheduler.flight
+            if fl is not None:
+                # capture the pre-drain state (the black box's "engine shut
+                # down on purpose" record) before the sweep mutates it
+                fl.auto_dump("drain")
             with self._wakeup:
                 self.scheduler.draining = True
                 step_outs: list = []
@@ -713,6 +755,12 @@ class Engine:
                     "engine wedged: no step progress for %.1fs with work "
                     "pending; marking unhealthy", stalled_for,
                 )
+                fl = self.scheduler.flight
+                if fl is not None:
+                    # lock-free by design: auto_dump takes only the
+                    # recorder's own lock, never the engine lock the wedged
+                    # step thread is holding
+                    fl.auto_dump("watchdog_stall")
                 # best-effort in-flight-frame abort: only possible when the
                 # step thread is NOT holding the lock (e.g. wedged outside
                 # the step body); a blocked acquire here would deadlock the
@@ -750,6 +798,9 @@ class Engine:
                 # go false at N) and keep the loop alive — the gateway
                 # routes around an unhealthy worker while it retries.
                 self.scheduler._count_step_failure("loop")
+                # a health-flip crossing counted here (outside a step) would
+                # otherwise wait for the next step to dump
+                self.scheduler.flush_pending_dumps()
                 logger.exception(
                     "engine step failed (%d consecutive)",
                     self.scheduler.consec_step_failures,

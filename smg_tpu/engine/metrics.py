@@ -271,6 +271,13 @@ class EngineMetrics:
             "for step_watchdog_secs while work was pending)",
             registry=r,
         ))
+        self.flight_dumps = _track(Counter(
+            "smg_engine_flight_dumps_total",
+            "Flight-recorder postmortem dumps by trigger (reason: "
+            "quarantine, health_flip, watchdog_stall, drain; rate-limited "
+            "per reason — see engine/flight_recorder.py)",
+            ["reason"], registry=r,
+        ))
         # overlapped decode pipeline (scheduler one-step lookahead)
         self.lookahead_launches = _track(Counter(
             "smg_engine_lookahead_launches_total",

@@ -94,6 +94,10 @@ class EngineRequest:
     # decode-state reuse in the overlap pipeline (rids are client-supplied
     # and reusable; object ids recycle after GC)
     sched_serial: int = -1
+    # gateway OTel trace id (32 hex chars) propagated over the worker hop;
+    # recorded into the flight-recorder timeline so a postmortem dump links
+    # back to the request's distributed trace.  None = no trace context.
+    trace_id: str | None = None
 
     @property
     def prompt_len(self) -> int:

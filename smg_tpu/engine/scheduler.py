@@ -164,6 +164,33 @@ class Scheduler:
         # drain mode (engine.stop(drain=True)): admission stops — in-progress
         # PREFILLING continuations and RUNNING lanes still finish
         self.draining = False
+        # flight recorder (engine/flight_recorder.py): step-level black box —
+        # per-step ring + per-request timelines, auto-dumped on quarantine /
+        # health flip (plus watchdog/drain at the engine layer).  Host-side
+        # metadata only; every hook below is None-guarded so the recorder can
+        # be disabled for A/B overhead benches.
+        self.flight = None
+        if getattr(config, "flight_recorder", True):
+            from smg_tpu.engine.flight_recorder import FlightRecorder
+
+            self.flight = FlightRecorder(
+                ring_size=getattr(config, "flight_ring_size", 256),
+                timeline_keep=getattr(config, "flight_timeline_keep", 64),
+                dump_dir=getattr(config, "flight_dump_dir", None),
+                dump_min_interval_secs=getattr(
+                    config, "flight_dump_min_interval_secs", 5.0
+                ),
+            )
+            self.flight.metrics = metrics
+        # step-scoped recorder state (reset at the top of every step)
+        self._step_fault_phases: list[str] = []
+        self._step_admissions = 0
+        self._step_outcome: str | None = None
+        self._step_fetch_s = 0.0
+        # dump reasons raised mid-step (quarantine, health flip): fired AFTER
+        # the step's own ring record lands, so the dump contains the failing
+        # step rather than ending one short of it
+        self._pending_dumps: list[str] = []
 
     # ---- public API ----
 
@@ -181,6 +208,27 @@ class Scheduler:
         req.sched_serial = self._serial
         self.requests[req.rid] = req
         self.waiting.append(req)
+        if self.flight is not None:
+            self.flight.on_queued(
+                req.rid, prompt_tokens=len(req.prompt_ids),
+                trace_id=req.trace_id, meta=self._flight_meta(req),
+                deadline_t=req.deadline,
+            )
+
+    def _flight_meta(self, req: EngineRequest) -> dict:
+        """Sampling/route metadata recorded into the request's timeline (the
+        postmortem needs to show HOW a request was running, not just when)."""
+        sp = req.sampling
+        meta = {
+            "temperature": sp.temperature, "top_p": sp.top_p,
+            "top_k": sp.top_k, "max_new_tokens": sp.max_new_tokens,
+            "priority": req.priority,
+        }
+        if sp.lora_adapter:
+            meta["lora"] = sp.lora_adapter
+        if req.token_filter is not None:
+            meta["constrained"] = True
+        return meta
 
     def _check_queue_capacity(self, req: EngineRequest) -> None:
         """Bounded-queue backpressure at submit time.  Only NEW submissions
@@ -218,7 +266,7 @@ class Scheduler:
                 pass
             req.status = RequestStatus.ABORTED
             req.finish = FinishInfo(reason="abort")
-            self._count_finish("abort")
+            self._count_finish(req, "abort")
             self.requests.pop(rid, None)
             return True
         self._release(req, FinishInfo(reason="abort"), aborted=True)
@@ -330,18 +378,59 @@ class Scheduler:
         poisoned batch never livelocks the engine."""
         outputs: list[StepOutput] = []
         self._step_had_failure = False
+        fl = self.flight
+        self._step_fault_phases = []
+        self._step_admissions = 0
+        self._step_outcome = None
+        self._step_fetch_s = 0.0
+        pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
+        t0 = time.perf_counter()
+        escaped = True  # exception past recovery -> engine loop (phase=loop)
         try:
-            self._step_inner(outputs)
-        except Exception as e:  # noqa: BLE001 — isolation boundary
-            self._recover_decode_failure(outputs, e)
-        else:
-            if not self._step_had_failure:
-                # only a step with NO recorded failure resets the streak —
-                # a step that quarantined a prefill failure completed, but
-                # counting it as clean would make the unhealthy threshold
-                # unreachable for a worker failing every prefill
-                self.consec_step_failures = 0
+            try:
+                self._step_inner(outputs)
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                self._recover_decode_failure(outputs, e)
+            else:
+                if not self._step_had_failure:
+                    # only a step with NO recorded failure resets the streak —
+                    # a step that quarantined a prefill failure completed, but
+                    # counting it as clean would make the unhealthy threshold
+                    # unreachable for a worker failing every prefill
+                    self.consec_step_failures = 0
+            escaped = False
+        finally:
+            if fl is not None:
+                # the ring record lands even for a step whose exception is
+                # escaping to the engine loop — a postmortem that omits the
+                # failing step is useless
+                fl.record_step(
+                    step_s=time.perf_counter() - t0,
+                    prefill_tokens=self.num_prefill_tokens - pf0,
+                    decode_tokens=self.num_decode_tokens - dc0,
+                    running=sum(1 for s in self.slots if s is not None),
+                    waiting=len(self.waiting),
+                    max_batch=self.sched.max_batch_size,
+                    prefill_inflight_tokens=self.prefill_inflight_tokens(),
+                    free_pages=self.pool.free_count,
+                    admissions=self._step_admissions,
+                    finishes=sum(1 for o in outputs if o.finished),
+                    overlap=self._step_outcome,
+                    fetch_wait_s=self._step_fetch_s,
+                    faults=self._step_fault_phases + (["loop"] if escaped else []),
+                )
+                self.flush_pending_dumps()
         return outputs
+
+    def flush_pending_dumps(self) -> None:
+        """Fire dump reasons raised mid-step (quarantine, health flip) now
+        that the triggering step's ring record is in place.  Also called by
+        the engine loop's last-resort handler for escaped exceptions."""
+        if self.flight is None or not self._pending_dumps:
+            return
+        pending, self._pending_dumps = self._pending_dumps, []
+        for reason in pending:
+            self.flight.auto_dump(reason)
 
     def _step_inner(self, outputs: list[StepOutput]) -> None:
         m = self.metrics
@@ -358,6 +447,8 @@ class Scheduler:
         )
         if overlap:
             admit_s, fetch_s, outcome = self._step_overlap(outputs)
+            # stash for the step's flight-recorder ring record
+            self._step_outcome, self._step_fetch_s = outcome, fetch_s
         else:
             self.drop_inflight()  # mode flip mid-run: never strand a frame
             self._admit(outputs)
@@ -412,6 +503,13 @@ class Scheduler:
         self.num_quarantined += 1
         if self.metrics is not None:
             self.metrics.quarantined_requests.inc()
+        if self.flight is not None:
+            # the quarantine event lands BEFORE the terminal finish moves the
+            # timeline to the finished ring, so the dump identifies the
+            # blamed request; the dump itself is deferred until this step's
+            # ring record is in place (flush_pending_dumps)
+            self.flight.event(req.rid, "quarantine", message=message[:200])
+            self._pending_dumps.append("quarantine")
         if req.status in (RequestStatus.WAITING, RequestStatus.PREEMPTED):
             try:
                 self.waiting.remove(req)
@@ -423,7 +521,7 @@ class Scheduler:
         else:
             req.finish = finish
             req.status = RequestStatus.FINISHED
-            self._count_finish("error")
+            self._count_finish(req, "error", message)
             self.requests.pop(req.rid, None)
         outputs.append(StepOutput(req, [], True, finish))
 
@@ -433,6 +531,15 @@ class Scheduler:
         self._step_had_failure = True
         if self.metrics is not None:
             self.metrics.step_failures.labels(phase=phase).inc()
+        self._step_fault_phases.append(phase)
+        if (
+            self.flight is not None
+            and self.consec_step_failures
+            == self.config.max_consecutive_step_failures
+        ):
+            # the streak just crossed the unhealthy threshold: Engine.healthy
+            # flips false after this step — capture the run-up
+            self._pending_dumps.append("health_flip")
 
     def _recover_decode_failure(
         self, outputs: list[StepOutput], exc: Exception
@@ -490,7 +597,9 @@ class Scheduler:
             self.waiting.remove(req)
             req.status = RequestStatus.FINISHED
             req.finish = FinishInfo(reason="timeout")
-            self._count_finish("timeout")
+            if self.flight is not None:
+                self.flight.event(req.rid, "deadline", state="waiting")
+            self._count_finish(req, "timeout")
             self.requests.pop(req.rid, None)
             self.num_deadline_waiting += 1
             if self.metrics is not None:
@@ -503,6 +612,8 @@ class Scheduler:
                 and now > req.deadline
                 and not req.is_finished
             ):
+                if self.flight is not None:
+                    self.flight.event(req.rid, "deadline", state="running")
                 self._release(req, FinishInfo(reason="timeout"))
                 self.num_deadline_running += 1
                 if self.metrics is not None:
@@ -519,7 +630,7 @@ class Scheduler:
             req = self.waiting.popleft()
             req.status = RequestStatus.ABORTED
             req.finish = FinishInfo(reason="abort", message="engine draining")
-            self._count_finish("abort")
+            self._count_finish(req, "abort", "engine draining")
             self.requests.pop(req.rid, None)
             outputs.append(StepOutput(req, [], True, req.finish))
 
@@ -1010,14 +1121,14 @@ class Scheduler:
                 reason="error",
                 message=f"prompt length {len(prompt)} exceeds max_seq_len {self.sched.max_seq_len}",
             )
-            self._count_finish("error")
+            self._count_finish(req, "error", req.finish.message)
             outputs.append(StepOutput(req, [], True, req.finish))
             return "consumed"
         if req.sampling.max_new_tokens == 0:
             self.waiting.popleft()
             req.status = RequestStatus.FINISHED
             req.finish = FinishInfo(reason="length")
-            self._count_finish("length")
+            self._count_finish(req, "length")
             outputs.append(StepOutput(req, [], True, req.finish))
             return "consumed"
 
@@ -1081,6 +1192,11 @@ class Scheduler:
         row[: len(all_pages)] = all_pages
         self.slots[slot] = req
         self._pages_dirty = True
+        self._step_admissions += 1
+        if self.flight is not None:
+            self.flight.event(
+                req.rid, "admitted", slot=slot, cached_tokens=matched_tokens
+            )
         return req
 
     def _prefill_chunk(self, req: EngineRequest, take: int) -> None:
@@ -1102,6 +1218,10 @@ class Scheduler:
         self.num_prefill_tokens += len(chunk)
         req.prefill_pos += len(chunk)
         req.seq_len = req.prefill_pos
+        if self.flight is not None:
+            self.flight.event(
+                req.rid, "prefill_chunk", start=start, n=len(chunk), final=False
+            )
 
     def _prefill_final(
         self, req: EngineRequest, outputs: list[StepOutput]
@@ -1139,6 +1259,10 @@ class Scheduler:
         req.prefill_pos = len(prompt)
         req.seq_len = len(prompt)
         req.status = RequestStatus.RUNNING
+        if self.flight is not None:
+            self.flight.event(
+                req.rid, "prefill_chunk", start=start, n=len(chunk), final=True
+            )
         self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
 
     def _mask_for(self, req: EngineRequest) -> np.ndarray:
@@ -1194,6 +1318,11 @@ class Scheduler:
             self.num_prefill_tokens += len(chunk)
             start += len(chunk)
             req.prefill_pos = start
+            if self.flight is not None:
+                self.flight.event(
+                    req.rid, "prefill_chunk", start=start - len(chunk),
+                    n=len(chunk), final=start >= len(prompt),
+                )
         req.seq_len = len(prompt)
         req.status = RequestStatus.RUNNING
         self._accept_tokens(req, [tok], [lp], outputs, advance_seq=False)
@@ -1341,6 +1470,11 @@ class Scheduler:
             req.seq_len = req.total_len
             req.prefill_pos = req.seq_len
             req.status = RequestStatus.RUNNING
+            if self.flight is not None:
+                self.flight.event(
+                    req.rid, "prefill_chunk", start=chunks[i][1],
+                    n=len(chunks[i][0]), final=True, grouped=True,
+                )
             self._accept_tokens(
                 # smglint: disable-next=HOTSYNC toks/lps fetched in prefill_batched
                 req, [int(toks[i])], [float(lps[i])], outputs, advance_seq=False
@@ -1699,6 +1833,11 @@ class Scheduler:
     def _preempt(self, req: EngineRequest) -> None:
         logger.warning("preempting request %s (out of KV pages)", req.rid)
         self.num_preemptions += 1
+        if self.flight is not None:
+            self.flight.event(
+                req.rid, "preempt", at_tokens=req.seq_len,
+                status=req.status.value,
+            )
         slot = req.slot
         self.slots[slot] = None
         self.page_tables[slot][:] = 0
@@ -1759,6 +1898,7 @@ class Scheduler:
         beyond the stop (decode horizon) is discarded — its KV writes landed
         in owned pages past seq_len, which never enter the radix cache."""
         sp = req.sampling
+        had_output = bool(req.output_ids)
         accepted: list[int] = []
         accepted_lps: list[float] = []
         finish: FinishInfo | None = None
@@ -1779,6 +1919,10 @@ class Scheduler:
                 finish = FinishInfo(reason="length")
             if finish is not None:
                 break
+        if self.flight is not None and accepted:
+            # TTFT/ITL sampling rides acceptance (host timestamps only); the
+            # call precedes _release so token ordering beats the finish event
+            self.flight.on_tokens(req.rid, len(accepted), first=not had_output)
         if finish is not None:
             self._release(req, finish)
         outputs.append(
@@ -1855,6 +1999,14 @@ class Scheduler:
         row[: len(pages)] = pages
         self.slots[slot] = req
         self._pages_dirty = True
+        if self.flight is not None:
+            # PD adoptee: queued+admitted collapse into one adoption instant
+            # (its prefill ran on the other leg's worker)
+            self.flight.on_queued(
+                req.rid, prompt_tokens=req.prompt_len, trace_id=req.trace_id,
+                meta=self._flight_meta(req),
+            )
+            self.flight.event(req.rid, "adopted", slot=slot)
         # first_token is accepted by the caller (stop checks + client emission)
         del first_token
         return True
@@ -1872,16 +2024,21 @@ class Scheduler:
             return
         self._release(req, FinishInfo(reason=reason, matched_stop=matched_stop))
 
-    def _count_finish(self, reason: str) -> None:
+    def _count_finish(
+        self, req: EngineRequest, reason: str, message: str | None = None
+    ) -> None:
         if self.metrics is not None:
             self.metrics.on_finish(reason)
+        if self.flight is not None:
+            # terminal timeline event: moves the request to the finished ring
+            self.flight.on_finish(req.rid, reason, message)
 
     def _release(
         self, req: EngineRequest, finish: FinishInfo, aborted: bool = False
     ) -> None:
         req.finish = finish
         req.status = RequestStatus.ABORTED if aborted else RequestStatus.FINISHED
-        self._count_finish(finish.reason)
+        self._count_finish(req, finish.reason, finish.message)
         if req.slot is not None:
             self.page_tables[req.slot][:] = 0
             self._pages_dirty = True

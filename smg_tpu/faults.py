@@ -23,6 +23,10 @@ Fault points (wired at the call sites listed):
 ``worker.stream``       per streamed chunk in ``InProcWorkerClient.generate``
                         (simulated transport death mid-stream)
 ``rpc.generate``        at entry of the worker servicer's Generate handler
+``flight.dump``         inside the flight recorder's auto-dump path
+                        (``engine/flight_recorder.py``) — proves a failing
+                        postmortem dump degrades to a log line instead of
+                        compounding the failure that triggered it
 =====================  =====================================================
 
 Trigger grammar (``arm()`` kwargs, or ``SMG_FAULTS`` entries):
@@ -60,6 +64,7 @@ FAULT_POINTS = (
     "engine.device_fetch",
     "worker.stream",
     "rpc.generate",
+    "flight.dump",
 )
 
 _MODES = ("always", "once", "after", "every")

@@ -88,6 +88,12 @@ def build_engine_from_args(args):
             args, "device_metrics_interval_secs", 10.0
         ),
         step_watchdog_secs=getattr(args, "step_watchdog_secs", 0.0),
+        flight_recorder=getattr(args, "flight_recorder", "on") != "off",
+        flight_ring_size=getattr(args, "flight_ring_size", 256),
+        flight_dump_dir=getattr(args, "flight_dump_dir", None),
+        flight_dump_min_interval_secs=getattr(
+            args, "flight_dump_min_interval_secs", 5.0
+        ),
     )
     params = None
     vision_params = None

@@ -10,7 +10,9 @@ the hook points match).
 from __future__ import annotations
 
 import contextvars
+import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 
 from prometheus_client import (
@@ -21,11 +23,15 @@ from prometheus_client import (
     generate_latest,
 )
 
-from smg_tpu.utils import get_logger
+from smg_tpu.utils import get_logger, percentile
 
 logger = get_logger("gateway.observability")
 
 LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# inter-token gaps sit well under request latencies: sub-ms decode steps on
+# TPU up to multi-second stalls behind an interfering prefill
+ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
 #: ambient HTTP route for metric labels below the handler layer —
 #: ``track_request`` parks the route here so the router can label TTFT
@@ -83,6 +89,30 @@ class Metrics:
             "smg_scheduler_queue_wait_seconds", "Priority-scheduler queue wait",
             ["priority"], buckets=LATENCY_BUCKETS, registry=r,
         )
+        # ---- SLO / goodput accounting (fed by the router via self.slo) ----
+        self.itl = Histogram(
+            "smg_inter_token_latency_seconds",
+            "Inter-token latency (per-TOKEN gap, sampled once per streamed "
+            "chunk: chunk arrival gap divided by tokens in the chunk)",
+            ["route"], buckets=ITL_BUCKETS, registry=r,
+        )
+        self.deadline_outcomes = Counter(
+            "smg_request_deadline_outcomes_total",
+            "Requests WITH a deadline (--request-timeout-secs) by outcome: "
+            "met = finished cleanly inside the budget, missed = expired or "
+            "errored past it",
+            ["outcome"], registry=r,
+        )
+        self.goodput_tokens = Counter(
+            "smg_goodput_tokens_total",
+            "Output tokens of requests that completed successfully within "
+            "their deadline (no deadline = vacuously met); goodput = rate() "
+            "of this vs smg_generated_tokens_total",
+            registry=r,
+        )
+        #: per-request SLO timeline accounting behind the three families
+        #: above, plus the /debug/slo rolling summary with trace-id exemplars
+        self.slo = SloTracker(self)
 
     def export(self) -> bytes:
         return generate_latest(self.registry)
@@ -115,3 +145,209 @@ class _RequestTracker:
 
     def __init__(self):
         self.status = "200"
+
+
+# ---- SLO / goodput accounting --------------------------------------------
+#
+# The engine's flight recorder keeps per-request timelines WORKER-side; this
+# is the gateway-side twin over router dispatches: TTFT / ITL / e2e against
+# each request's deadline, goodput (= deadline-met token throughput), and a
+# bounded ring of completed-request records carrying trace-id exemplars that
+# link a /debug/slo row to its OTel trace and its worker flight timeline.
+
+
+class SloRequest:
+    """One routed request's SLO accounting handle (router-held).  Terminal
+    transitions are idempotent: the first of finish/fail/abandon wins."""
+
+    __slots__ = (
+        "_tracker", "rid", "route", "trace_id", "t_start", "deadline_s",
+        "t_first", "t_last", "prompt_tokens", "cached_tokens",
+        "output_tokens", "itl_total", "itl_tokens", "_done",
+    )
+
+    def __init__(self, tracker: "SloTracker", rid: str, route: str,
+                 deadline_s: float | None, trace_id: str | None,
+                 t_start: float):
+        self._tracker = tracker
+        self.rid = rid
+        self.route = route
+        self.trace_id = trace_id
+        self.t_start = t_start  # the FIRST-dispatch clock, never reset
+        self.deadline_s = deadline_s
+        self.t_first: float | None = None
+        self.t_last: float | None = None
+        self.prompt_tokens = 0
+        self.cached_tokens = 0
+        self.output_tokens = 0
+        self.itl_total = 0.0
+        self.itl_tokens = 0
+        self._done = False
+
+    def first_token(self, prompt_tokens: int, cached_tokens: int) -> None:
+        if self.t_first is not None:
+            return
+        now = time.perf_counter()
+        self.t_first = self.t_last = now
+        self.prompt_tokens = prompt_tokens
+        self.cached_tokens = cached_tokens
+
+    def tokens(self, n: int) -> None:
+        """Record ``n`` output tokens arriving now; gaps after the first
+        chunk contribute ITL samples (per-chunk mean gap)."""
+        if n <= 0:
+            return
+        now = time.perf_counter()
+        if self.t_last is not None and self.output_tokens > 0:
+            # PER-TOKEN gap, everywhere: the histogram sample and the
+            # record's itl_mean_s must agree with each other (and with the
+            # engine flight timeline) regardless of chunking/decode horizon
+            gap = now - self.t_last
+            self.itl_total += gap
+            self.itl_tokens += n
+            m = self._tracker.metrics
+            if m is not None:
+                m.itl.labels(route=self.route).observe(gap / n)
+        self.t_last = now
+        self.output_tokens += n
+
+    def finish(self, reason: str | None) -> None:
+        self._terminal(reason or "stop", error=False)
+
+    def fail(self, reason: str = "error") -> None:
+        self._terminal(reason, error=True)
+
+    def abandon(self, reason: str = "abort") -> None:
+        """Terminal fallback for VOLUNTARY endings (client disconnect,
+        cancellation); no-op once terminal.  Excluded from deadline
+        outcomes — a fast client abort is neither met nor missed, and
+        counting it as missed would inflate SLO miss rate with endings the
+        server did not cause."""
+        self._terminal(reason, error=True, voluntary=True)
+
+    def _terminal(self, reason: str, error: bool,
+                  voluntary: bool = False) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._tracker._complete(self, reason, error, voluntary)
+
+
+class SloTracker:
+    """Bounded completed-request ring + rolling aggregates for /debug/slo.
+
+    Locked: routers on the event loop write, /debug/slo and tests read; the
+    critical sections are dict/deque appends, never I/O."""
+
+    def __init__(self, metrics: "Metrics | None" = None, keep: int = 256):
+        self.metrics = metrics
+        self.keep = keep
+        self._lock = threading.Lock()
+        self._done: deque = deque(maxlen=keep)
+        self.num_requests = 0
+
+    def begin(
+        self, rid: str, route: str = "unknown",
+        deadline_secs: float | None = None, trace_id: str | None = None,
+        t_start: float | None = None,
+    ) -> SloRequest:
+        return SloRequest(
+            self, rid, route, deadline_secs, trace_id,
+            time.perf_counter() if t_start is None else t_start,
+        )
+
+    def _complete(self, req: SloRequest, reason: str, error: bool,
+                  voluntary: bool = False) -> None:
+        t_end = time.perf_counter()
+        e2e = t_end - req.t_start
+        # a deadline is met only by a CLEAN finish inside the budget; engine
+        # "timeout" finishes and router errors are misses by definition.
+        # VOLUNTARY endings (client disconnect) count toward neither.
+        clean = not error and reason not in ("timeout", "error")
+        if req.deadline_s is not None:
+            met = clean and e2e <= req.deadline_s
+        else:
+            met = clean  # vacuous deadline: success = goodput
+        m = self.metrics
+        if m is not None:
+            if req.deadline_s is not None and not voluntary:
+                m.deadline_outcomes.labels(
+                    outcome="met" if met else "missed"
+                ).inc()
+            if met and req.output_tokens:
+                m.goodput_tokens.inc(req.output_tokens)
+        record = {
+            "rid": req.rid,
+            "route": req.route,
+            "trace_id": req.trace_id,
+            "reason": reason,
+            "ttft_s": (req.t_first - req.t_start)
+            if req.t_first is not None else None,
+            "e2e_s": e2e,
+            "itl_mean_s": (req.itl_total / req.itl_tokens)
+            if req.itl_tokens else None,
+            "prompt_tokens": req.prompt_tokens,
+            "cached_tokens": req.cached_tokens,
+            "output_tokens": req.output_tokens,
+            "deadline_s": req.deadline_s,
+            "deadline_met": met,
+            "voluntary": voluntary,
+            "t_end": t_end,
+        }
+        with self._lock:
+            self.num_requests += 1
+            self._done.append(record)
+
+    def summary(self, recent: int = 32) -> dict:
+        """Rolling SLO summary over the completed-request ring (the
+        /debug/slo payload).  Percentiles are over per-request values; ITL
+        is the per-request mean gap.  Goodput rate spans the ring window."""
+        with self._lock:
+            records = list(self._done)
+            total = self.num_requests
+        ttfts = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
+        itls = [r["itl_mean_s"] for r in records if r["itl_mean_s"] is not None]
+        e2es = [r["e2e_s"] for r in records]
+        with_deadline = [
+            r for r in records
+            if r["deadline_s"] is not None and not r["voluntary"]
+        ]
+        good_tokens = sum(
+            r["output_tokens"] for r in records if r["deadline_met"]
+        )
+        all_tokens = sum(r["output_tokens"] for r in records)
+        span = (
+            max(r["t_end"] for r in records)
+            - min(r["t_end"] - r["e2e_s"] for r in records)
+            if records else 0.0
+        )
+        reasons: dict[str, int] = {}
+        for r in records:
+            reasons[r["reason"]] = reasons.get(r["reason"], 0) + 1
+        return {
+            "window_requests": len(records),
+            "total_requests": total,
+            "finish_reasons": reasons,
+            "ttft": {"p50_s": percentile(ttfts, 50),
+                     "p95_s": percentile(ttfts, 95)},
+            "itl": {"p50_s": percentile(itls, 50),
+                    "p95_s": percentile(itls, 95)},
+            "e2e": {"p50_s": percentile(e2es, 50),
+                    "p95_s": percentile(e2es, 95)},
+            "deadline": {
+                "with_deadline": len(with_deadline),
+                "met": sum(1 for r in with_deadline if r["deadline_met"]),
+                "missed": sum(
+                    1 for r in with_deadline if not r["deadline_met"]
+                ),
+            },
+            "goodput": {
+                "tokens": good_tokens,
+                "total_tokens": all_tokens,
+                "tokens_per_s": (good_tokens / span) if span > 1e-9 else 0.0,
+                "ratio": (good_tokens / all_tokens) if all_tokens else 1.0,
+            },
+            # trace-id exemplars: each row links to its OTel trace and (via
+            # the propagated traceparent) its worker flight timeline
+            "recent": records[-recent:],
+        }

@@ -283,6 +283,25 @@ class Router:
         )
 
         prefill_pool, decode_pool = self._pd_pools(ctx.model_id)
+
+        # SINGLE first-dispatch clock for TTFT + SLO attribution, shared by
+        # every dispatch path (regular, PD) and NEVER reset on failover: a
+        # WorkerQueueFullError retry or backoff sleep shows up in
+        # smg_time_to_first_token_seconds instead of vanishing into an
+        # attribution gap (satellite: TTFT retry attribution)
+        t_dispatch = time.perf_counter()
+        srec = None
+        if self.metrics is not None:
+            from smg_tpu.gateway.tracing import current_span
+
+            span = current_span.get()
+            srec = self.metrics.slo.begin(
+                rid, route=current_route.get(),
+                deadline_secs=self.config.request_timeout_secs,
+                trace_id=span.trace_id if span is not None else None,
+                t_start=t_dispatch,
+            )
+
         mm_exclude: set[str] = set()
         if mm is not None and prefill_pool and decode_pool:
             # PD prefill-export doesn't carry the mm splice yet: route image
@@ -297,6 +316,8 @@ class Router:
                 if w.worker_type in (WorkerType.DECODE, WorkerType.ENCODE)
             ]
             if len(typed) == len(self._candidate_workers(ctx.model_id)):
+                if srec is not None:
+                    srec.fail("error")
                 raise RouteError(
                     503,
                     "image input needs a prefill-capable worker; this PD "
@@ -308,11 +329,24 @@ class Router:
                 "request %s has image input; bypassing PD disaggregation", rid
             )
         elif prefill_pool and decode_pool:
-            async for ev in self._execute_pd(
-                ctx, input_ids, worker_sampling, rid, detok, stop_checker,
-                prefill_pool, decode_pool,
-            ):
-                yield ev
+            try:
+                async for ev in self._execute_pd(
+                    ctx, input_ids, worker_sampling, rid, detok, stop_checker,
+                    prefill_pool, decode_pool, t_dispatch=t_dispatch,
+                    srec=srec,
+                ):
+                    yield ev
+            except (GeneratorExit, asyncio.CancelledError):
+                if srec is not None:
+                    srec.abandon("abort")
+                raise
+            except BaseException:
+                # pre-stream PD failures (no healthy prefill worker, export
+                # error, decode selection) must still land in SLO accounting
+                # — _execute_pd's own terminal calls are idempotent
+                if srec is not None:
+                    srec.fail("error")
+                raise
             return
 
         attempts = 0
@@ -320,9 +354,6 @@ class Router:
         saw_queue_full = False
         # dp-rank cost estimate: prompt + generation budget (released on exit)
         dp_cost = len(input_ids) + (worker_sampling.max_new_tokens or 0)
-        # TTFT is attributed from dispatch start: worker selection + engine
-        # queue + prefill, across retries (tokenize happened upstream)
-        t_dispatch = time.perf_counter()
         # remaining-budget deadline for --request-timeout-secs propagation:
         # each (re)dispatch hands the engine only what is left
         budget_deadline = (
@@ -334,6 +365,8 @@ class Router:
             try:
                 worker = self.select_worker(ctx, exclude=exclude)
             except RouteError:
+                if srec is not None:
+                    srec.fail("rate_limited" if saw_queue_full else "error")
                 if saw_queue_full:
                     # every candidate rejected with backpressure: the honest
                     # front-door answer is 429 retry-later, not a 5xx
@@ -398,10 +431,15 @@ class Router:
                         self.metrics.prompt_tokens.inc(chunk.prompt_tokens)
                         if chunk.cached_tokens:
                             self.metrics.cached_tokens.inc(chunk.cached_tokens)
+                        if srec is not None:
+                            srec.first_token(chunk.prompt_tokens,
+                                             chunk.cached_tokens)
                     if self.metrics is not None and chunk.output_tokens > last_output_tokens:
                         self.metrics.generated_tokens.inc(
                             chunk.output_tokens - last_output_tokens
                         )
+                        if srec is not None:
+                            srec.tokens(chunk.output_tokens - last_output_tokens)
                     got_first_chunk = True
                     last_output_tokens = chunk.output_tokens
                     if decode_span is not None:
@@ -411,6 +449,11 @@ class Router:
                     else:
                         ev = self._chunk_to_event(chunk, detok, stop_checker)
                     if ev is not None:
+                        if srec is not None and ev.finished:
+                            # terminal SLO record BEFORE the yield: a consumer
+                            # that stops iterating at the final event closes
+                            # this generator at the yield point
+                            srec.finish(ev.finish_reason)
                         yield ev
                         if ev.finished and not chunk.finished:
                             # gateway-side stop: cancel the worker stream
@@ -419,6 +462,8 @@ class Router:
                             guard.release(success=True)
                             return
                     if chunk.finished:
+                        if srec is not None:
+                            srec.finish(chunk.finish_reason)  # no-op if done
                         finished_cleanly = True
                         guard.release(success=True)
                         return
@@ -426,11 +471,15 @@ class Router:
                 raise RuntimeError("worker stream ended unexpectedly")
             except RouteError:
                 guard.release(success=False)
+                if srec is not None:
+                    srec.fail("error")
                 raise
             except (GeneratorExit, asyncio.CancelledError):
                 # client disconnected / stream task cancelled: not a worker
                 # failure — release the load guard and stop the generation
                 guard.release(success=True)
+                if srec is not None:
+                    srec.abandon("abort")
                 try:
                     await asyncio.shield(worker.client.abort(rid))
                 except Exception:
@@ -446,6 +495,8 @@ class Router:
                 attempts += 1
                 exclude.add(worker.worker_id)
                 if attempts > max(self.config.max_retries, 1):
+                    if srec is not None:
+                        srec.fail("rate_limited")
                     raise RouteError(
                         429, "all workers at capacity; retry later",
                         "rate_limit_error",
@@ -463,6 +514,8 @@ class Router:
                 exclude.add(worker.worker_id)
                 if got_first_chunk or attempts >= self.config.max_retries:
                     logger.exception("request %s failed on %s", rid, worker.worker_id)
+                    if srec is not None:
+                        srec.fail("error")
                     raise RouteError(502, f"worker error: {e}", "worker_error")
                 if self.metrics is not None:
                     self.metrics.retries_total.inc()
@@ -488,13 +541,19 @@ class Router:
 
     async def _execute_pd(
         self, ctx, input_ids, worker_sampling, rid, detok, stop_checker,
-        prefill_pool, decode_pool,
+        prefill_pool, decode_pool, t_dispatch: float | None = None,
+        srec=None,
     ):
         """PD-disaggregated execution: prefill leg computes + exports the
         prompt KV; decode leg imports it and streams tokens (reference:
         dual-dispatch in request_execution.rs:34-82; KV rides the connector
-        seam — host-mediated here, ICI/DCN on multi-chip deployments)."""
-        t_dispatch = time.perf_counter()  # TTFT attribution, as in _execute
+        seam — host-mediated here, ICI/DCN on multi-chip deployments).
+
+        ``t_dispatch``/``srec`` are the FIRST-dispatch TTFT clock and SLO
+        handle created by ``_execute`` — shared so PD attribution matches
+        the regular path and is never restarted mid-request."""
+        if t_dispatch is None:
+            t_dispatch = time.perf_counter()
         policy = self.policies.policy_for(ctx.model_id)
         p_worker = policy.select_worker(prefill_pool, ctx)
         if p_worker is None:
@@ -603,14 +662,21 @@ class Router:
                     self.metrics.prompt_tokens.inc(chunk.prompt_tokens)
                     if chunk.cached_tokens:
                         self.metrics.cached_tokens.inc(chunk.cached_tokens)
+                    if srec is not None:
+                        srec.first_token(chunk.prompt_tokens,
+                                         chunk.cached_tokens)
                 got_first_chunk = True
                 if self.metrics is not None and chunk.output_tokens > last_output_tokens:
                     self.metrics.generated_tokens.inc(
                         chunk.output_tokens - last_output_tokens
                     )
+                    if srec is not None:
+                        srec.tokens(chunk.output_tokens - last_output_tokens)
                 last_output_tokens = chunk.output_tokens
                 ev = self._chunk_to_event(chunk, detok, stop_checker)
                 if ev is not None:
+                    if srec is not None and ev.finished:
+                        srec.finish(ev.finish_reason)
                     yield ev
                     if ev.finished and not chunk.finished:
                         await d_worker.client.abort(rid)
@@ -618,12 +684,16 @@ class Router:
                         d_guard.release(success=True)
                         return
                 if chunk.finished:
+                    if srec is not None:
+                        srec.finish(chunk.finish_reason)
                     finished_cleanly = True
                     d_guard.release(success=True)
                     return
             raise RuntimeError("decode stream ended unexpectedly")
         except (GeneratorExit, asyncio.CancelledError):
             d_guard.release(success=True)
+            if srec is not None:
+                srec.abandon("abort")
             try:
                 await asyncio.shield(d_worker.client.abort(rid))
             except Exception:
@@ -631,9 +701,13 @@ class Router:
             raise
         except RouteError:
             d_guard.release(success=False)
+            if srec is not None:
+                srec.fail("error")
             raise
         except Exception as e:
             d_guard.release(success=False)
+            if srec is not None:
+                srec.fail("error")
             raise RouteError(502, f"decode worker error: {e}", "worker_error")
         finally:
             end_stage(d_span, error=not finished_cleanly,

@@ -546,6 +546,10 @@ def build_app(ctx: AppContext, client_max_size: int = 256 * 2**20) -> web.Applic
 
     app.router.add_get("/metrics", h_metrics)
     app.router.add_get("/scheduler", h_scheduler_stats)
+    # flight-recorder / SLO postmortem surface (engine/flight_recorder.py +
+    # observability.SloTracker): worker black-box dumps + rolling SLO summary
+    app.router.add_get("/debug/flight/{worker_id}", h_debug_flight)
+    app.router.add_get("/debug/slo", h_debug_slo)
     app.router.add_get("/health", h_health)
     app.router.add_get("/liveness", h_health)
     app.router.add_get("/readiness", h_readiness)
@@ -635,6 +639,40 @@ async def h_scheduler_stats(request: web.Request) -> web.Response:
     results = await asyncio.gather(*(_loads(w) for w in ctx.registry.list()))
     body["engine"] = dict(results)
     return web.json_response(body)
+
+
+async def h_debug_flight(request: web.Request) -> web.Response:
+    """Worker flight-recorder dump (postmortem black box): the engine's
+    per-step ring + per-request timelines, fetched over the worker's
+    transport (in-proc direct, remote via the DumpFlight RPC).  ``?reason=``
+    tags the dump (default ``manual``)."""
+    ctx: AppContext = request.app["ctx"]
+    wid = request.match_info["worker_id"]
+    worker = ctx.registry.get(wid)
+    if worker is None:
+        return _error(404, f"unknown worker {wid}")
+    reason = request.query.get("reason", "manual")
+    try:
+        # generous-but-bounded: a dump is a diagnostic fetch, possibly from
+        # a wedged worker — do not let it hang the debug endpoint forever
+        dump = await asyncio.wait_for(
+            worker.client.dump_flight(reason=reason), 30.0
+        )
+    except NotImplementedError:
+        return _error(501, f"worker {wid} has no flight recorder",
+                      "not_implemented")
+    except Exception as e:
+        return _error(502, f"flight dump from {wid} failed: {e}",
+                      "worker_error")
+    return web.json_response({"worker_id": wid, "dump": dump})
+
+
+async def h_debug_slo(request: web.Request) -> web.Response:
+    """Rolling gateway-side SLO/goodput summary: TTFT/ITL/e2e percentiles,
+    deadline met/missed, goodput token rate, and recent per-request records
+    with trace-id exemplars (observability.SloTracker)."""
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response(ctx.metrics.slo.summary())
 
 
 async def h_health(request: web.Request) -> web.Response:

@@ -229,6 +229,20 @@ current_tracer: contextvars.ContextVar["OtelTracer | None"] = contextvars.Contex
 )
 
 
+def ambient_traceparent() -> str | None:
+    """W3C traceparent of the ambient request span (worker-hop metadata),
+    or None when no trace is active.  The ONE place propagation headers are
+    built — regular and PD dispatch legs must not diverge."""
+    span = current_span.get()
+    return span.traceparent if span is not None else None
+
+
+def ambient_trace_id() -> str | None:
+    """Trace id of the ambient request span (in-proc engine link)."""
+    span = current_span.get()
+    return span.trace_id if span is not None else None
+
+
 def start_stage(name: str, **attrs) -> Span | None:
     """Open a child span of the ambient request span; None when tracing is
     off (zero overhead — no tracer, no span objects)."""

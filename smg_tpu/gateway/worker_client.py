@@ -98,6 +98,12 @@ class WorkerClient:
     async def health(self) -> bool:
         raise NotImplementedError
 
+    async def dump_flight(self, reason: str = "manual") -> dict:
+        """Engine flight-recorder dump (postmortem black box): the per-step
+        ring + per-request timelines as a schema-versioned JSON-able dict.
+        Gateway surface: GET /debug/flight/{worker}."""
+        raise NotImplementedError("flight recorder unsupported by this worker")
+
     async def get_loads(self) -> dict:
         raise NotImplementedError
 
@@ -182,10 +188,17 @@ class InProcWorkerClient(WorkerClient):
             )
             loop.call_soon_threadsafe(q.put_nowait, chunk)
 
+        # in-proc trace link: the ambient request span's trace id threads
+        # straight into the engine request (the gRPC transport carries the
+        # same id as traceparent metadata) so flight-recorder timelines link
+        # to the request's OTel trace regardless of transport
+        from smg_tpu.gateway.tracing import ambient_trace_id
+
         try:
             self.engine.submit(
                 req.input_ids, req.sampling, rid=req.rid, on_output=on_output,
                 mm_embeds=req.mm_embeds, timeout_secs=req.timeout_secs,
+                trace_id=ambient_trace_id(),
             )
         except QueueFullError as e:
             # transport-level shape of engine backpressure: the router
@@ -247,11 +260,14 @@ class InProcWorkerClient(WorkerClient):
             )
             loop.call_soon_threadsafe(q.put_nowait, chunk)
 
+        from smg_tpu.gateway.tracing import ambient_trace_id
+
+        trace_id = ambient_trace_id()
         await loop.run_in_executor(
             None,
             lambda: self.engine.submit_prefilled(
                 req.input_ids, first_token, k, v, req.sampling,
-                rid=req.rid, on_output=on_output,
+                rid=req.rid, on_output=on_output, trace_id=trace_id,
             ),
         )
         while True:
@@ -265,6 +281,11 @@ class InProcWorkerClient(WorkerClient):
         # of consecutive step failures reports false here, so HealthMonitor
         # and breakers route around the worker while it recovers
         return bool(getattr(self.engine, "healthy", True))
+
+    async def dump_flight(self, reason: str = "manual") -> dict:
+        # dump_flight takes only the recorder's own lock (never the engine
+        # lock), but snapshot serialization is real work — off the loop
+        return await asyncio.to_thread(self.engine.dump_flight, reason)
 
     async def get_loads(self) -> dict:
         # includes engine-deep stats: cached/computed prompt tokens,

@@ -90,6 +90,14 @@ class GrpcWorkerClient(WorkerClient):
     setup_timeout_secs: float = 30.0
     bulk_timeout_secs: float = 600.0
 
+    @staticmethod
+    def _trace_metadata():
+        """gRPC metadata carrying the ambient span's traceparent, or None."""
+        from smg_tpu.gateway.tracing import ambient_traceparent
+
+        tp = ambient_traceparent()
+        return (("traceparent", tp),) if tp else None
+
     def __init__(self, url: str):
         if "://" in url:
             url = url.split("://", 1)[1]
@@ -168,6 +176,11 @@ class GrpcWorkerClient(WorkerClient):
             request_serializer=pb.KvOfferProto.SerializeToString,
             response_deserializer=pb.AbortResponseProto.FromString,
         )
+        self._dump_flight = c.unary_unary(
+            method("DumpFlight"),
+            request_serializer=pb.FlightDumpRequestProto.SerializeToString,
+            response_deserializer=pb.FlightDumpResponseProto.FromString,
+        )
         self._abort = c.unary_unary(
             method("Abort"),
             request_serializer=pb.AbortRequestProto.SerializeToString,
@@ -215,7 +228,11 @@ class GrpcWorkerClient(WorkerClient):
         mm = mm_embeds_to_proto(getattr(req, "mm_embeds", None))
         if mm is not None:
             msg.mm_embeds.CopyFrom(mm)
-        call = self._generate(msg)
+        # W3C trace propagation over the worker hop: the gateway's ambient
+        # request span rides gRPC metadata, so worker-side spans and the
+        # engine's flight-recorder timeline join the SAME trace instead of
+        # each worker hop rooting a fresh one
+        call = self._generate(msg, metadata=self._trace_metadata())
         try:
             async for chunk in iter_with_idle_timeout(
                 call, self.idle_timeout_secs, self.url,
@@ -306,7 +323,9 @@ class GrpcWorkerClient(WorkerClient):
             msg.v = v.tobytes()
             msg.kv_shape.extend(list(k.shape))
             msg.kv_dtype = str(k.dtype)
-        call = self._generate_prefilled(msg)
+        # same trace propagation as generate(): the PD decode leg's timeline
+        # must link to the request's trace too
+        call = self._generate_prefilled(msg, metadata=self._trace_metadata())
         try:
             async for chunk in iter_with_idle_timeout(
                 call, self.idle_timeout_secs, self.url,
@@ -414,6 +433,20 @@ class GrpcWorkerClient(WorkerClient):
             return resp.ok
         except grpc.aio.AioRpcError:
             return False
+
+    async def dump_flight(self, reason: str = "manual") -> dict:
+        """Fetch the worker's flight-recorder dump (postmortem black box).
+        ``setup`` timeout class: a dump is a diagnostic document, not a
+        hot-path call, and a wedged worker may be slow to serialize it."""
+        import json
+
+        resp = await self._dump_flight(
+            pb.FlightDumpRequestProto(reason=reason),
+            timeout=self.setup_timeout_secs,
+        )
+        if resp.error:
+            raise RuntimeError(f"worker flight dump error: {resp.error}")
+        return json.loads(resp.json)
 
     async def abort(self, rid: str) -> bool:
         try:

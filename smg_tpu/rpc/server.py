@@ -38,13 +38,29 @@ class SchedulerServicer:
     replica with the fewest queued tokens.  Aux RPCs (tokenizer, LoRA,
     profile, model info) address replica 0 — replicas are homogeneous."""
 
-    def __init__(self, engine=None, engines: "list | None" = None):
+    def __init__(self, engine=None, engines: "list | None" = None, tracer=None):
         if engines is None:
             engines = [engine]
         if not engines or any(e is None for e in engines):
             raise ValueError("need at least one engine")
         self.engines = list(engines)
         self.engine = self.engines[0]
+        # optional worker-side OtelTracer: with one configured, Generate
+        # opens a SERVER span as a CHILD of the gateway's propagated
+        # traceparent instead of rooting a fresh trace per worker hop
+        self.tracer = tracer
+
+    @staticmethod
+    def _traceparent(context) -> "str | None":
+        """W3C traceparent from gRPC request metadata (the client attaches
+        it from the gateway's ambient request span)."""
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key == "traceparent":
+                    return value
+        except Exception:
+            return None
+        return None
 
     def _engine_for(self, rank: int):
         """Pick the DP replica for a request; raises on out-of-range pins."""
@@ -73,6 +89,20 @@ class SchedulerServicer:
         # fault point: worker-side RPC failure before any engine state is
         # touched (the reliability suite's retry/breaker scenarios fire here)
         FAULTS.fire("rpc.generate", rid=rid)
+        # trace propagation over the worker hop: the traceparent rides gRPC
+        # metadata; the parsed trace id threads into the engine request so
+        # flight-recorder timelines link back to the gateway's OTel trace,
+        # and a worker-side tracer (when configured) parents its span under
+        # the same trace instead of rooting a new one
+        from smg_tpu.gateway.tracing import parse_traceparent
+
+        traceparent = self._traceparent(context)
+        trace_ctx = parse_traceparent(traceparent)
+        trace_id = trace_ctx[0] if trace_ctx else None
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span("worker.generate", traceparent=traceparent)
+            span.set("rid", rid)
         try:
             engine = self._engine_for(request.data_parallel_rank)
             engine.submit(
@@ -80,19 +110,28 @@ class SchedulerServicer:
                 on_output=on_output, priority=request.priority,
                 mm_embeds=mm_embeds_from_proto(request.mm_embeds),
                 timeout_secs=request.timeout_secs or None,
+                trace_id=trace_id,
             )
         except QueueFullError as e:
             # admission backpressure is RETRYABLE, not a request error: a
             # status the client maps to try-another-worker / HTTP 429
+            self._end_span(span, error=True)
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except ValueError as e:
             # invalid sampling config (e.g. unsupported regex/ebnf constraint):
             # structured terminal chunk, mirroring the sibling handlers
+            self._end_span(span, error=True)
             yield pb.GenerateChunk(
                 rid=rid, finished=True, finish_reason="error", error=str(e),
                 matched_stop_token=-1,
             )
             return
+        except BaseException:
+            # unexpected submit failure: the span must not leak precisely on
+            # the path a trace is most needed for
+            self._end_span(span, error=True)
+            raise
+        finished = False
         try:
             while True:
                 out = await q.get()
@@ -111,10 +150,18 @@ class SchedulerServicer:
                 )
                 yield chunk
                 if out.finished:
+                    finished = True
                     return
         finally:
             # client went away mid-stream: stop generating
+            self._end_span(span, error=not finished)
             engine.abort(rid)
+
+    def _end_span(self, span, error: bool = False) -> None:
+        if span is None or self.tracer is None:
+            return
+        span.end(error=error)
+        self.tracer.record(span)
 
     async def Embed(self, request: pb.EmbedRequestProto, context):
         loop = asyncio.get_running_loop()
@@ -254,11 +301,15 @@ class SchedulerServicer:
             loop.call_soon_threadsafe(q.put_nowait, out)
 
         rid = base.rid
+        from smg_tpu.gateway.tracing import parse_traceparent
+
+        trace_ctx = parse_traceparent(self._traceparent(context))
         await loop.run_in_executor(
             None,
             lambda: self.engine.submit_prefilled(
                 list(base.input_ids), request.first_token, k, v, sampling,
                 rid=rid, on_output=on_output,
+                trace_id=trace_ctx[0] if trace_ctx else None,
             ),
         )
         try:
@@ -291,6 +342,36 @@ class SchedulerServicer:
         else:
             ok = mgr.reclaim(request.uuid)
         return pb.AbortResponseProto(ok=ok)
+
+    async def DumpFlight(self, request: pb.FlightDumpRequestProto, context):
+        """Flight-recorder fetch (postmortem black box): per-DP-rank dumps
+        as schema-versioned JSON.  Runs in an executor WITHOUT the engine
+        lock (dump_flight is deliberately lock-free at the engine layer) so
+        a wedged worker can still answer a postmortem fetch."""
+        import json
+
+        from smg_tpu.engine.flight_recorder import SCHEMA_VERSION
+
+        loop = asyncio.get_running_loop()
+        reason = request.reason or "manual"
+        try:
+            dumps = await loop.run_in_executor(
+                None, lambda: [e.dump_flight(reason) for e in self.engines]
+            )
+            if len(dumps) == 1:
+                payload = dumps[0]
+            else:
+                # DP wrapper keeps the schema_version contract at the top
+                # level; consumers detect the shape via the "engines" key
+                payload = {
+                    "schema_version": SCHEMA_VERSION,
+                    "dp_size": len(dumps),
+                    "engines": dumps,
+                }
+            return pb.FlightDumpResponseProto(json=json.dumps(payload))
+        except Exception as e:
+            logger.exception("flight dump failed")
+            return pb.FlightDumpResponseProto(error=str(e))
 
     async def Abort(self, request: pb.AbortRequestProto, context):
         ok = any(e.abort(request.rid) for e in self.engines)
@@ -463,6 +544,11 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
             request_deserializer=pb.KvOfferProto.FromString,
             response_serializer=pb.AbortResponseProto.SerializeToString,
         ),
+        "DumpFlight": grpc.unary_unary_rpc_method_handler(
+            servicer.DumpFlight,
+            request_deserializer=pb.FlightDumpRequestProto.FromString,
+            response_serializer=pb.FlightDumpResponseProto.SerializeToString,
+        ),
         "Abort": grpc.unary_unary_rpc_method_handler(
             servicer.Abort,
             request_deserializer=pb.AbortRequestProto.FromString,
@@ -528,7 +614,8 @@ def _handlers(servicer: SchedulerServicer) -> grpc.GenericRpcHandler:
 
 
 async def serve_worker_async(
-    engine, port: int, host: str = "0.0.0.0", engines: "list | None" = None
+    engine, port: int, host: str = "0.0.0.0", engines: "list | None" = None,
+    tracer=None,
 ) -> grpc.aio.Server:
     server = grpc.aio.server(
         options=[
@@ -537,7 +624,7 @@ async def serve_worker_async(
         ]
     )
     server.add_generic_rpc_handlers(
-        (_handlers(SchedulerServicer(engine, engines=engines)),)
+        (_handlers(SchedulerServicer(engine, engines=engines, tracer=tracer)),)
     )
     bound = server.add_insecure_port(f"{host}:{port}")
     await server.start()
