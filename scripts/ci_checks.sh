@@ -13,6 +13,11 @@
 #                       plus mid-prefill preemption/abort lifecycle
 #                       (tests/test_chunked_prefill.py + the chunked cases
 #                       in tests/test_overlap.py);
+#   4b. megastep decode — K-sweep byte-parity vs K=1 (temp 0 and 0.8,
+#                       overlap on/off), device done-mask early exit,
+#                       quarantine rewind across a megastep, adaptive
+#                       horizon controller, 0-recompile at K=8
+#                       (tests/test_megastep.py);
 #   5. reliability    — engine failure isolation driven through the
 #                       smg_tpu/faults.py fault points: poison-step
 #                       quarantine (survivor byte-parity + zero leaks),
@@ -41,6 +46,10 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
 echo "== chunked-prefill scheduling parity =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chunked_prefill.py \
     tests/test_overlap.py -q -m 'not slow' -p no:cacheprovider
+
+echo "== megastep decode K-sweep parity =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_megastep.py -q \
+    -m 'not slow' -p no:cacheprovider
 
 echo "== reliability / failure isolation =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_reliability.py -q \

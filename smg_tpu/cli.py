@@ -254,6 +254,29 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                         "n-gram proposals)")
     g.add_argument("--draft-model-preset", default=None, dest="draft_model_preset",
                    help="named preset for the draft model")
+    g.add_argument("--decode-horizon", type=int, default=1,
+                   dest="decode_horizon",
+                   help="decode steps fused per device call (the megastep: "
+                        "K sampled tokens per host round trip with device-"
+                        "side EOS/stop/length detection and early exit). "
+                        "Token streams are byte-identical to K=1 at any "
+                        "temperature; grammar-constrained and stop-string "
+                        "requests transparently force K=1")
+    g.add_argument("--adaptive-horizon", default="off", choices=["on", "off"],
+                   dest="adaptive_horizon",
+                   help="pick the decode horizon per step from observed "
+                        "finish rates, KV page headroom, and pending "
+                        "admissions (capped at --decode-horizon-max, or "
+                        "--decode-horizon when unset); 'off' always uses "
+                        "--decode-horizon")
+    g.add_argument("--decode-horizon-max", type=int, default=0,
+                   dest="decode_horizon_max",
+                   help="compiled megastep width and adaptive-horizon cap; "
+                        "one trace per batch bucket serves every K <= this "
+                        "(0 = follow --decode-horizon).  Pending admissions "
+                        "always collapse K to 1 so the per-step prefill "
+                        "budget keeps flowing and streams stay byte-"
+                        "identical to K=1")
     g.add_argument("--overlap-schedule", default="on", choices=["on", "off"],
                    dest="overlap_schedule",
                    help="one-step-lookahead decode pipeline: the next device "

@@ -341,6 +341,30 @@ def validate_cli_args(args) -> list[ValidationIssue]:
     if g("spec_max_draft") is not None and g("spec_max_draft") < 1:
         issues.append(_err("spec_max_draft", "must be >= 1"))
 
+    # ---- megastep decode horizon (serve/worker mode)
+    if g("decode_horizon") is not None and g("decode_horizon") < 1:
+        issues.append(_err("decode_horizon", "must be >= 1"))
+    if (
+        g("decode_horizon_max")
+        and g("decode_horizon") is not None
+        and g("decode_horizon_max") < g("decode_horizon")
+    ):
+        issues.append(_err(
+            "decode_horizon_max",
+            f"compiled horizon cap {g('decode_horizon_max')} is below "
+            f"--decode-horizon {g('decode_horizon')}",
+        ))
+    if (
+        g("adaptive_horizon") == "on"
+        and (g("decode_horizon") or 1) <= 1
+        and not g("decode_horizon_max")
+    ):
+        issues.append(_warn(
+            "adaptive_horizon",
+            "adaptive horizon with cap 1 (neither --decode-horizon nor "
+            "--decode-horizon-max above 1) never fuses steps",
+        ))
+
     # ---- mesh TLS coherence
     tls_parts = [g("mesh_tls_cert"), g("mesh_tls_key"), g("mesh_tls_ca")]
     if any(tls_parts) and not all(tls_parts):

@@ -82,11 +82,31 @@ class SchedulerConfig:
     schedule_policy: str = "fcfs"  # fcfs | priority
     enable_prefix_cache: bool = True
     watermark_pages: int = 8  # keep this many pages free before admitting prefill
-    # decode steps fused per device call (lax.scan); sampled tokens feed back
-    # on-device and the host syncs once per horizon.  >1 trades stop-condition
-    # granularity (up to N-1 discarded overshoot tokens) for dispatch
-    # amortization — the right trade on TPU where host round trips are slow.
+    # decode steps fused per device call (the megastep: a lax.while_loop with
+    # in-loop sampling-key folds and device-side stop detection); sampled
+    # tokens feed back on-device and the host syncs once per horizon.  Token
+    # streams are byte-identical to decode_horizon=1 at ANY temperature: each
+    # in-loop column folds the exact key the single-step path would have, a
+    # per-lane done mask (EOS/stop-token ids + max-token budget) early-exits
+    # the loop at the first finish, and the host trims acceptance at that
+    # column and rewinds the unused key folds before relaunching.  >1
+    # amortizes the per-step host round trip ~K-fold — the decisive lever on
+    # TPU where dispatch latency rivals step compute.
     decode_horizon: int = 1
+    # adaptive horizon controller: pick K per step from page headroom and
+    # observed finish rates (EMA of columns-until-finish), capped at
+    # horizon_cap.  Pending admission work (waiting queue / resumable
+    # prefills) forces K=1 in EVERY mode — a K=1 schedule can admit between
+    # any two decode steps, so a horizon spanning an admission point would
+    # break byte-parity; grammar masks / stop strings / speculative decoding
+    # force K=1 exactly like the static path.
+    adaptive_horizon: bool = False
+    # compiled horizon bound: the megastep jit is traced ONCE per batch
+    # bucket with this as the loop's static output width, and the per-launch
+    # K rides a device scalar — so neither the static decode_horizon sweep
+    # nor the adaptive controller costs a retrace.  0 = follow decode_horizon
+    # (the default keeps the K=1 trace as lean as today's).
+    decode_horizon_max: int = 0
     # single-chunk prompts admitted together in one batched prefill call
     # (fills the MXU and amortizes dispatch; long prompts still chunk solo)
     max_prefill_group: int = 8
@@ -134,6 +154,19 @@ class SchedulerConfig:
                 "prefill_mix_policy must be 'stall-free' or 'throughput', "
                 f"got {self.prefill_mix_policy!r}"
             )
+        if self.decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
+        if self.decode_horizon_max and self.decode_horizon_max < self.decode_horizon:
+            raise ValueError(
+                "decode_horizon_max must be 0 or >= decode_horizon"
+            )
+
+    @property
+    def horizon_cap(self) -> int:
+        """Compiled megastep width: the static bound every decode trace is
+        built with (per-launch K <= this rides a device scalar, so varying K
+        never retraces)."""
+        return max(self.decode_horizon_max, self.decode_horizon, 1)
 
     def prefill_bucket(self, n_tokens: int) -> int:
         for b in self.prefill_token_buckets:

@@ -56,14 +56,16 @@ from smg_tpu.utils import get_logger, percentile
 logger = get_logger("engine.flight_recorder")
 
 #: bump when the dump layout changes; consumers key parsing off this
-SCHEMA_VERSION = 1
+#: (v2: megastep decode telemetry — per-step horizon K, device early
+#: exits, and wasted-token count joined the step record)
+SCHEMA_VERSION = 2
 
 #: stable key set of one step record (schema contract, tested)
 STEP_RECORD_KEYS = frozenset({
     "serial", "t", "kind", "step_s", "running", "waiting", "occupancy",
     "prefill_tokens", "decode_tokens", "prefill_inflight_tokens",
     "free_pages", "admissions", "finishes", "overlap", "fetch_wait_s",
-    "faults",
+    "faults", "horizon", "early_exits", "wasted_decode_tokens",
 })
 
 
@@ -186,6 +188,8 @@ class FlightRecorder:
         prefill_inflight_tokens: int, free_pages: int,
         admissions: int, finishes: int, overlap: str | None,
         fetch_wait_s: float, faults: list | None = None,
+        horizon: int = 0, early_exits: int = 0,
+        wasted_decode_tokens: int = 0,
     ) -> int:
         """Append one step record; returns the step serial.  Called once per
         scheduler step with values already in hand — no derivation here."""
@@ -216,6 +220,12 @@ class FlightRecorder:
                 "overlap": overlap,
                 "fetch_wait_s": fetch_wait_s,
                 "faults": list(faults) if faults else [],
+                # megastep decode: K of the consumed frame (0 = no decode
+                # consumed), device done-mask early exits, and columns
+                # computed but never emitted this step
+                "horizon": horizon,
+                "early_exits": early_exits,
+                "wasted_decode_tokens": wasted_decode_tokens,
             })
             return self.step_serial
 

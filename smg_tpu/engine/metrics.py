@@ -278,6 +278,30 @@ class EngineMetrics:
             "per reason — see engine/flight_recorder.py)",
             ["reason"], registry=r,
         ))
+        # megastep decode (device-fused K-step horizon, engine/runner.py)
+        self.decode_horizon = _track(Gauge(
+            "smg_engine_decode_horizon",
+            "Decode horizon K of the most recent consumed megastep (tokens "
+            "per device round trip; 1 = single-step, forced for grammar-"
+            "masked and stop-string batches; the adaptive controller moves "
+            "this with finish rates, page headroom, and admission pressure)",
+            registry=r,
+        ))
+        self.wasted_decode_tokens = _track(Counter(
+            "smg_engine_wasted_decode_tokens_total",
+            "Decode token slots computed on device but never emitted: "
+            "horizon columns past a finish (normally zero thanks to the "
+            "done-mask early exit) plus discarded lookahead frames counted "
+            "at full width (upper bound — their results are never fetched)",
+            registry=r,
+        ))
+        self.megastep_early_exits = _track(Counter(
+            "smg_engine_megastep_early_exits_total",
+            "Megastep device loops that exited before the requested horizon "
+            "because a lane finished (EOS/stop-token/length detected by the "
+            "device-side done mask)",
+            registry=r,
+        ))
         # overlapped decode pipeline (scheduler one-step lookahead)
         self.lookahead_launches = _track(Counter(
             "smg_engine_lookahead_launches_total",
@@ -361,6 +385,7 @@ class EngineMetrics:
         total_pages: int,
         cached_pages: int,
         cumulative: dict | None = None,
+        decode_horizon: int = 0,
     ) -> None:
         """Record one scheduler step.  ``prefill_tokens``/``decode_tokens``
         are this step's deltas; ``cumulative`` carries the scheduler's
@@ -373,6 +398,8 @@ class EngineMetrics:
         if decode_tokens:
             self.step_duration.labels(phase="decode").observe(decode_s)
             self.decode_tokens.inc(decode_tokens)
+            if decode_horizon > 0:
+                self.decode_horizon.set(decode_horizon)
         if prefill_tokens or decode_tokens:
             kind = (
                 "mixed" if (prefill_tokens and decode_tokens)
@@ -400,6 +427,8 @@ class EngineMetrics:
             ("radix_miss_pages", self.radix_miss_pages),
             ("radix_evicted_pages", self.radix_evicted_pages),
             ("cached_prompt_tokens", self.cached_prompt_tokens),
+            ("wasted_decode_tokens", self.wasted_decode_tokens),
+            ("megastep_early_exits", self.megastep_early_exits),
         ):
             if cumulative and key in cumulative:
                 self._bump(key, counter, int(cumulative[key]))

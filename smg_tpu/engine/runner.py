@@ -18,6 +18,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from smg_tpu.engine.config import EngineConfig
 from smg_tpu.engine.kv_cache import KvCacheSpec, create_kv_buffers, plan_cache
@@ -91,6 +92,7 @@ class DecodeState:
         "lane_sig", "temps", "topks", "topps", "minps",
         "slot_idx", "freqs", "pres", "reps", "lora_idx", "rope_delta",
         "pt_sig", "page_tables",
+        "stop_ids", "limits", "live",
     )
 
     def __init__(self):
@@ -101,6 +103,15 @@ class DecodeState:
         self.rope_delta = None
         self.pt_sig = None
         self.page_tables = None
+        # megastep device-side stop state, uploaded once per composition
+        # change: per-lane stop-token id set ([B, E], -1 padded; EOS ids
+        # included unless ignore_eos), absolute total-length limits ([B]:
+        # min(prompt_len + max_new_tokens, max_seq_len)), and the real-lane
+        # mask ([B] bool — padded rows start "done" so they never gate the
+        # early exit)
+        self.stop_ids = None
+        self.limits = None
+        self.live = None
 
 
 class ModelRunner:
@@ -465,6 +476,17 @@ class ModelRunner:
     def rng_restore(self, mark: int) -> None:
         self._step = mark
 
+    def _consume_folds(self, n: int) -> int:
+        """Advance the sampling-key counter for ``n`` IN-LOOP folds (one per
+        megastep column: column j folds counter value mark+1+j on device,
+        exactly the key the K=1 path's ``_next_key`` would produce at that
+        global step).  Returns the pre-advance mark; the scheduler rewinds to
+        ``mark + used`` when a finish trims the horizon so the relaunch
+        refolds the same keys the single-step schedule would have."""
+        mark = self._step
+        self._step += n
+        return mark
+
     def _prefill_fn(self, T: int, mp: int, use_pen: bool = False,
                     use_mask: bool = False, use_lora: bool = False,
                     use_ring: bool = False, use_embeds: bool = False,
@@ -772,24 +794,46 @@ class ModelRunner:
         toks, lps = jax.device_get((toks, lps))  # intended blocking fetch
         return toks[:g_real], lps[:g_real]
 
-    def _decode_multi_fn(self, B: int, mp: int, N: int,
+    def _decode_multi_fn(self, B: int, mp: int, N: int, E: int = 0,
                          use_pen: bool = False, use_mask: bool = False,
                          use_lora: bool = False, use_mrope: bool = False):
-        """N decode steps fused into one jitted lax.scan: sampled tokens feed
-        back on-device, so host round trips amortize N-fold (the decisive win
-        when dispatch latency rivals step compute).  Overshoot past a
-        finished/stopped sequence writes to the garbage page and is trimmed
-        host-side.
+        """The decode MEGASTEP: up to N decode steps fused into one jitted
+        ``lax.while_loop`` with in-loop sampling-key folds and device-side
+        stop detection.  Sampled tokens feed back on-device, so host round
+        trips amortize K-fold (the decisive win when dispatch latency rivals
+        step compute) — and the loop bound ``n_steps`` rides a device scalar,
+        so ONE trace per batch bucket serves every K <= N (compile time no
+        longer scales with the horizon).
+
+        Byte-parity with the single-step path at any temperature: column j
+        folds ``fold_in(base_key, step0 + 1 + j)`` — exactly the key
+        ``_next_key`` would have produced at that global step — so a megastep
+        is indistinguishable from K consecutive single-step launches.
+
+        Device-side stop detection (``E > 0``): a per-lane done mask tracks
+        stop-token hits ([B, E] id set: EOS + stop_token_ids) and the
+        absolute length limit ([B]); the loop EXITS at the first column where
+        any real lane finishes (padded lanes start done and never gate it).
+        Because the host trims acceptance at the earliest finish anyway (the
+        K=1-equivalence rule), exiting at the FIRST done lane strictly
+        subsumes per-lane freezing: no token beyond the exit column is ever
+        computed, so a finish inside a large horizon wastes nothing.  KV for
+        uncomputed columns is masked to the garbage page in the final
+        scatter.
 
         ``use_pen`` threads the per-slot [S+1, V] output-count/prompt-mask
-        buffers through the scan (counts update on-device as tokens are
-        sampled, so penalties stay exact across the horizon).  ``use_mask``
-        adds a [B, V] constrained-decoding vocab mask; the scheduler forces
-        N=1 for masked batches since the mask is host-derived per token.
-        ``use_lora`` adds the adapter bank + per-slot adapter indices.
-        ``use_mrope`` adds a [B] rope position delta (M-RoPE decode: text
-        axes are equal, so the offset rides the standard rope path)."""
-        k = ("decode_multi", B, mp, N, use_pen, use_mask, use_lora, use_mrope)
+        buffers through the loop (counts update on-device as tokens are
+        sampled, so penalties stay exact across the horizon — and exact
+        under a trim, since every computed column is an accepted column).
+        ``use_mask`` adds a [B, V] constrained-decoding vocab mask; the
+        scheduler forces N=1 for masked batches since the mask is
+        host-derived per token.  ``use_lora`` adds the adapter bank +
+        per-slot adapter indices.  ``use_mrope`` adds a [B] rope position
+        delta (M-RoPE decode: text axes are equal, so the offset rides the
+        standard rope path)."""
+        use_stop = E > 0
+        k = ("decode_multi", B, mp, N, E, use_pen, use_mask, use_lora,
+             use_mrope)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
@@ -802,7 +846,8 @@ class ModelRunner:
         n_slots = self.lora_slots
 
         def multi(params, inv_freq, tokens, entry_pos, kc, vc, page_tables,
-                  key, temps, topks, topps, minps, *extra):
+                  base_key, step0, n_steps, temps, topks, topps, minps,
+                  *extra):
             i = 0
             if use_pen:
                 counts_buf, pmask_buf, slot_idx, freqs, pres, reps = extra[:6]
@@ -816,40 +861,81 @@ class ModelRunner:
                 lora_bank, lora_idx = extra[i], extra[i + 1]
                 lora_gates = jax.nn.one_hot(lora_idx, n_slots, dtype=jnp.float32)
                 i += 2
-            rope_delta = extra[i] if use_mrope else None
-            keys = jax.random.split(key, N)
+            rope_delta = None
+            if use_mrope:
+                rope_delta = extra[i]
+                i += 1
+            if use_stop:
+                stop_ids, limits, live = extra[i], extra[i + 1], extra[i + 2]
             cache_dtype = kc.dtype
-            hk = jnp.zeros((L, B, N, KD), cache_dtype)
-            hv = jnp.zeros((L, B, N, KD), cache_dtype)
+            hk0 = jnp.zeros((L, B, N, KD), cache_dtype)
+            hv0 = jnp.zeros((L, B, N, KD), cache_dtype)
             counts0 = counts_buf[slot_idx] if use_pen else jnp.zeros((B, 0))
             pmask = pmask_buf[slot_idx] if use_pen else None
+            sampler = _pick_sampler()
+            # padded lanes start done so the any-real-lane-done exit ignores
+            # them; without stop detection nothing is ever done
+            done0 = (~live) if use_stop else jnp.zeros((B,), jnp.bool_)
 
-            def body(carry, xs):
-                toks, hk, hv, counts = carry
-                j, kj = xs
+            def cond(carry):
+                j, done = carry[0], carry[7]
+                ok = j < n_steps
+                if use_stop:
+                    # first finish ends the horizon: the host accepts nothing
+                    # past it (K=1 equivalence), so further columns are waste
+                    ok = jnp.logical_and(ok, ~jnp.any(done & live))
+                return ok
+
+            def body(carry):
+                j, cur, toks_out, lps_out, hk, hv, counts, done = carry
                 logits, hk, hv = module.forward_decode_horizon(
-                    params, cfg, inv_freq, toks, entry_pos + j, entry_pos, j,
+                    params, cfg, inv_freq, cur, entry_pos + j, entry_pos, j,
                     kc, vc, page_tables, hk, hv, attn_impl=attn_impl,
                     lora=lora_bank, lora_gates=lora_gates,
                     pp_mesh=(self.mesh if self.use_pp else None),
                     rope_delta=rope_delta,
                 )
                 if use_pen:
-                    logits = apply_penalties(logits, counts, pmask, freqs, pres, reps)
-                new, lps = _pick_sampler()(logits, kj, temps, topks, topps, minps,
-                                           mask=mask)
+                    logits = apply_penalties(logits, counts, pmask, freqs,
+                                             pres, reps)
+                # the IN-LOOP fold: column j's key is the key the K=1 path
+                # folds at global step step0+1+j (then split(.., 1)[0], the
+                # same per-launch split the single-step scan applied)
+                kj = jax.random.split(jax.random.fold_in(
+                    base_key, step0 + j.astype(jnp.uint32) + jnp.uint32(1)
+                ), 1)[0]
+                new, lps = sampler(logits, kj, temps, topks, topps, minps,
+                                   mask=mask)
                 if use_pen:
                     counts = counts.at[jnp.arange(B), new].add(1)
-                return (new, hk, hv, counts), (new, lps)
+                toks_out = lax.dynamic_update_slice(
+                    toks_out, new[:, None].astype(jnp.int32), (0, j)
+                )
+                lps_out = lax.dynamic_update_slice(
+                    lps_out, lps[:, None].astype(jnp.float32), (0, j)
+                )
+                if use_stop:
+                    tok_done = jnp.any(new[:, None] == stop_ids, axis=1)
+                    # length finish: total_len after accepting column j is
+                    # entry_pos + j + 2 (decode steady state: total = seq+1),
+                    # so the lane is done once entry_pos + j >= limit - 2
+                    done = done | tok_done | ((entry_pos + j) >= (limits - 2))
+                return (j + 1, new, toks_out, lps_out, hk, hv, counts, done)
 
-            (_, hk, hv, counts), (outs, lps) = jax.lax.scan(
-                body, (tokens, hk, hv, counts0), (jnp.arange(N), keys)
+            init = (
+                jnp.int32(0), tokens,
+                jnp.zeros((B, N), jnp.int32), jnp.zeros((B, N), jnp.float32),
+                hk0, hv0, counts0, done0,
             )
+            (steps_run, _cur, outs, lps, hk, hv, counts, _done) = \
+                lax.while_loop(cond, body, init)
 
-            # land the whole horizon into the donated cache in one scatter
+            # land the whole horizon into the donated cache in one scatter;
+            # uncomputed columns (early exit / n_steps < N) and positions
+            # past the table go to the reserved garbage page
             total = mp * ps
             pos = entry_pos[:, None] + jnp.arange(N)[None, :]  # [B, N]
-            valid = pos < total
+            valid = (pos < total) & (jnp.arange(N)[None, :] < steps_run)
             pos_c = jnp.minimum(pos, total - 1)
             page = jnp.take_along_axis(page_tables, pos_c // ps, axis=1)
             dest = jnp.where(valid, page * ps + pos_c % ps, 0).reshape(-1)  # [B*N]
@@ -864,11 +950,12 @@ class ModelRunner:
             ).reshape(vc.shape)
             if use_pen:
                 counts_buf = counts_buf.at[slot_idx].set(counts)
-                return outs.T, lps.T, kc, vc, counts_buf
-            return outs.T, lps.T, kc, vc  # [B, N]
+                return outs, lps, steps_run, kc, vc, counts_buf
+            return outs, lps, steps_run, kc, vc  # [B, N] toks/lps
 
         n_extra = ((6 if use_pen else 0) + (1 if use_mask else 0)
-                   + (2 if use_lora else 0) + (1 if use_mrope else 0))
+                   + (2 if use_lora else 0) + (1 if use_mrope else 0)
+                   + (3 if use_stop else 0))
         # KV donation aliases the cache update in place — essential on TPU
         # (cache is a large fraction of HBM).  The CPU backend, however,
         # BLOCKS the dispatching thread for the whole execution when any
@@ -876,15 +963,16 @@ class ModelRunner:
         # undonated returns in ~0.1ms), which would serialize the overlapped
         # decode pipeline on the host thread.  CPU memory is not the scarce
         # resource, so skip donation there and keep async dispatch.
-        donate = (4, 5) + ((12,) if use_pen else ())
+        donate = (4, 5) + ((14,) if use_pen else ())
         if self._kv_donation_blocks_dispatch():
             donate = ()
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r,
-                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r)
+                     self.kv_sharding, self.kv_sharding, r, r, r, r,
+                     r, r, r, r)
             in_sh = in_sh + (r,) * n_extra
-            out_sh = (r, r, self.kv_sharding, self.kv_sharding)
+            out_sh = (r, r, r, self.kv_sharding, self.kv_sharding)
             if use_pen:
                 out_sh = out_sh + (r,)
             fn = jax.jit(multi, in_shardings=in_sh, out_shardings=out_sh,
@@ -904,25 +992,50 @@ class ModelRunner:
         topps,
         minps,
         num_steps: int,
+        max_steps: int | None = None,
+        stop_state: tuple | None = None,  # (stop_ids [B,E], limits [B], live [B])
         pen: tuple | None = None,  # (slot_idx [B], freqs [B], pres [B], reps [B])
         mask: np.ndarray | None = None,  # [B, V] bool
         lora_idx=None,  # [B] adapter slot per row (0 = none)
         rope_delta=None,  # [B] M-RoPE decode offsets
-    ) -> tuple[jax.Array, jax.Array]:
-        """Dispatch a decode horizon and return UNMATERIALIZED result arrays
-        (tokens [B, num_steps], logprobs [B, num_steps]).  JAX async dispatch
+    ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Dispatch a decode megastep and return UNMATERIALIZED result arrays
+        (tokens [B, N], logprobs [B, N], steps_run scalar) where
+        N = ``max_steps or num_steps`` is the COMPILED width — the trace is
+        keyed on N, and the per-launch ``num_steps`` (<= N) rides a device
+        scalar, so an adaptive horizon never retraces.  JAX async dispatch
         means this returns as soon as the computation is enqueued — the
         overlapped scheduler consumes last step's tokens while this one runs.
         Every input accepts either numpy (uploaded once) or a resident
         ``jax.Array`` (``jnp.asarray`` is a no-op), which is how the
-        ``DecodeState`` buffers avoid per-step uploads."""
+        ``DecodeState`` buffers avoid per-step uploads.
+
+        ``stop_state`` (required when N > 1) arms device-side stop detection;
+        the loop early-exits at the first finishing lane.  The launch
+        consumes ``num_steps`` sampling-key folds (one per column, in-loop);
+        the caller rewinds the unused tail via ``rng_restore(mark + used)``
+        when a finish trims the horizon."""
         B, mp = page_tables.shape
+        N = max_steps or num_steps
         use_pen = pen is not None
         use_mask = mask is not None
         use_lora = lora_idx is not None and self._lora_bank is not None
         use_mrope = rope_delta is not None
-        fn = self._decode_multi_fn(B, mp, num_steps, use_pen, use_mask, use_lora,
+        E = 0
+        if N > 1:
+            if stop_state is None:
+                raise ValueError(
+                    "decode megastep with N > 1 requires stop_state — the "
+                    "device-side done mask is what keeps a multi-step "
+                    "horizon byte-identical to K=1"
+                )
+            E = stop_state[0].shape[1]
+        fn = self._decode_multi_fn(B, mp, N, E, use_pen, use_mask, use_lora,
                                    use_mrope)
+        # the megastep folds its own keys in-loop: consume num_steps counter
+        # values and upload the pre-advance mark; column j folds mark+1+j,
+        # exactly _next_key's value at that global step
+        mark = self._consume_folds(num_steps)
         # _dev: resident DecodeState buffers pass through (zero transfers in
         # steady state); host inputs upload EXPLICITLY so the transfer guard
         # can police this launch path
@@ -934,7 +1047,9 @@ class ModelRunner:
             self.k_cache,
             self.v_cache,
             _dev(page_tables, jnp.int32),
-            self._next_key(),
+            self._rng_key,
+            jax.device_put(np.uint32(mark)),
+            jax.device_put(np.int32(num_steps)),
             _dev(temps, jnp.float32),
             _dev(topks, jnp.int32),
             _dev(topps, jnp.float32),
@@ -957,12 +1072,20 @@ class ModelRunner:
             args += [self._lora_bank, _dev(lora_idx, jnp.int32)]
         if use_mrope:
             args.append(_dev(rope_delta, jnp.int32))
+        if E:
+            stop_ids, limits, live = stop_state
+            args += [
+                _dev(stop_ids, jnp.int32),
+                _dev(limits, jnp.int32),
+                _dev(live, jnp.bool_),
+            ]
         out = fn(*args)
         if use_pen:
-            toks, lps, self.k_cache, self.v_cache, self._counts_buf = out
+            toks, lps, steps_run, self.k_cache, self.v_cache, \
+                self._counts_buf = out
         else:
-            toks, lps, self.k_cache, self.v_cache = out
-        return toks, lps
+            toks, lps, steps_run, self.k_cache, self.v_cache = out
+        return toks, lps, steps_run
 
     def decode_multi(
         self,
@@ -974,20 +1097,39 @@ class ModelRunner:
         topps: np.ndarray,
         minps: np.ndarray,
         num_steps: int,
+        max_steps: int | None = None,
+        stop_state: tuple | None = None,
         pen: tuple | None = None,
         mask: np.ndarray | None = None,
         lora_idx: np.ndarray | None = None,
         rope_delta: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Synchronous decode horizon: dispatch + blocking fetch.
-        Returns (tokens [B, num_steps], logprobs [B, num_steps])."""
-        toks, lps = self.decode_multi_async(
+        Returns (tokens [B, n], logprobs [B, n]) where n is the number of
+        columns the device loop actually ran — num_steps unless a caller
+        -provided ``stop_state`` early-exited the loop (columns past the
+        exit are never computed and are not returned).
+
+        Runner-level callers (benches, tests) have no scheduler stop state;
+        a multi-step call without one gets a neutral never-done mask so the
+        loop runs the full horizon (n == num_steps) — overshoot semantics
+        identical to the pre-megastep scan."""
+        if stop_state is None and (max_steps or num_steps) > 1:
+            B = page_tables.shape[0]
+            stop_state = (
+                np.full((B, 1), -1, np.int32),  # no stop ids
+                np.full(B, np.int32(2**30)),  # unreachable length limit
+                np.ones(B, bool),
+            )
+        toks, lps, steps = self.decode_multi_async(
             tokens, positions, page_tables, temps, topks, topps, minps,
-            num_steps, pen=pen, mask=mask, lora_idx=lora_idx,
-            rope_delta=rope_delta,
+            num_steps, max_steps=max_steps, stop_state=stop_state,
+            pen=pen, mask=mask, lora_idx=lora_idx, rope_delta=rope_delta,
         )
-        toks, lps = jax.device_get((toks, lps))  # intended blocking fetch
-        return toks, lps
+        # intended blocking fetch
+        toks, lps, steps = jax.device_get((toks, lps, steps))
+        n = int(steps)
+        return toks[:, :n], lps[:, :n]
 
     def _decode_fn(self, B: int, mp: int):
         k = ("decode", B, mp)

@@ -82,13 +82,15 @@ class InFlightFrame:
 
     ``toks``/``lps`` are unmaterialized ``jax.Array``s: JAX async dispatch
     returns them before the device finishes, and ``np.asarray`` at consume
-    time is the deferred fetch.  ``rng_mark`` is set on lookahead frames so
-    a discarded launch can rewind the sampling-key counter."""
+    time is the deferred fetch.  ``rng_mark`` is set on every frame: the
+    megastep consumes ``folds`` (= horizon) sampling-key counter values at
+    launch (one in-loop fold per column), so a discarded frame rewinds all
+    of them and a horizon trimmed at a finish rewinds the unused tail."""
 
     lanes: list  # [(slot, EngineRequest, expected_seq_len)]
-    toks: "object"  # jax.Array [B, horizon]
-    lps: "object"  # jax.Array [B, horizon]
-    horizon: int
+    toks: "object"  # jax.Array [B, max_steps] (columns >= steps_run unset)
+    lps: "object"  # jax.Array [B, max_steps]
+    horizon: int  # requested K this launch (<= compiled max_steps)
     B: int  # padded batch bucket
     B_real: int
     mp_b: int
@@ -99,6 +101,8 @@ class InFlightFrame:
     use_mrope: bool = False
     rng_mark: int | None = None
     lookahead: bool = False
+    folds: int = 1  # sampling-key counter values consumed by the launch
+    steps_run: "object" = None  # jax.Array scalar: columns the device loop ran
 
 
 class Scheduler:
@@ -153,6 +157,20 @@ class Scheduler:
         self._serial = 0  # admission serial for decode-state signatures
         self.num_lookahead_kept = 0
         self.num_lookahead_discarded = 0
+        # megastep decode (device-fused K-step horizon) accounting + the
+        # adaptive horizon controller's observed-finish-rate state:
+        # wasted tokens = columns computed on device but never accepted
+        # (trimmed horizons — normally 0 thanks to the early exit — plus
+        # discarded lookahead frames, counted at their full width as an
+        # upper bound since their results are never fetched)
+        self.num_wasted_decode_tokens = 0
+        self.num_megastep_early_exits = 0
+        # EMA of decode columns between finishes (the controller sizes K so
+        # most horizons complete without a trim); 0 = no observation yet
+        self._finish_gap_ema = 0.0
+        self._cols_since_finish = 0
+        # step-scoped megastep telemetry for the flight-recorder ring
+        self._step_horizon = 0
         # failure isolation (poison-step quarantine / deadlines / drain)
         self.num_quarantined = 0
         self.num_step_failures = 0
@@ -340,6 +358,10 @@ class Scheduler:
             # discarded after a schedule change (stop/abort/rollback)
             "lookahead_kept": self.num_lookahead_kept,
             "lookahead_discarded": self.num_lookahead_discarded,
+            # megastep decode: device-computed-but-never-emitted columns and
+            # device-side early exits (a finish ended a horizon early)
+            "wasted_decode_tokens": self.num_wasted_decode_tokens,
+            "megastep_early_exits": self.num_megastep_early_exits,
             # failure isolation: quarantine/deadline/backpressure counters
             # the gateway's health + routing decisions key off
             "quarantined_requests": self.num_quarantined,
@@ -383,7 +405,9 @@ class Scheduler:
         self._step_admissions = 0
         self._step_outcome = None
         self._step_fetch_s = 0.0
+        self._step_horizon = 0
         pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
+        we0, ee0 = self.num_wasted_decode_tokens, self.num_megastep_early_exits
         t0 = time.perf_counter()
         escaped = True  # exception past recovery -> engine loop (phase=loop)
         try:
@@ -418,6 +442,9 @@ class Scheduler:
                     overlap=self._step_outcome,
                     fetch_wait_s=self._step_fetch_s,
                     faults=self._step_fault_phases + (["loop"] if escaped else []),
+                    horizon=self._step_horizon,
+                    early_exits=self.num_megastep_early_exits - ee0,
+                    wasted_decode_tokens=self.num_wasted_decode_tokens - we0,
                 )
                 self.flush_pending_dumps()
         return outputs
@@ -479,7 +506,10 @@ class Scheduler:
                     "radix_miss_pages": self.num_radix_miss_pages,
                     "radix_evicted_pages": self.radix.evicted_pages if self.radix else 0,
                     "cached_prompt_tokens": self.num_cached_prompt_tokens,
+                    "wasted_decode_tokens": self.num_wasted_decode_tokens,
+                    "megastep_early_exits": self.num_megastep_early_exits,
                 },
+                decode_horizon=self._step_horizon,
             )
             if outcome is not None:
                 m.observe_overlap(
@@ -684,16 +714,27 @@ class Scheduler:
             try:
                 if self._prefill_phase_fold_free():
                     look = self._launch_lookahead(frame)
-                fetch_s = self._consume_frame(frame, outputs)
+                fetch_s, used = self._consume_frame(frame, outputs)
             except Exception:
-                # quarantine path: rewind the NEWEST fold first (the chained
+                # quarantine path: rewind the NEWEST folds first (the chained
                 # lookahead launched off this frame), then stash the frame on
                 # ``inflight`` so the step-level handler's drop_inflight
-                # rewinds its fold too before the blame/retry refolds
+                # rewinds its folds too before the blame/retry refolds
                 if look is not None:
                     self._discard_frame(look)
                 self.inflight = frame
                 raise
+            if used < frame.horizon:
+                # a finish trimmed the horizon mid-frame: the chained
+                # lookahead no longer matches the sync schedule (the lane
+                # set changes at the finish), and the frame's UNUSED in-loop
+                # key folds must rewind BEFORE the prefill phase can fold —
+                # sync's next fold after the finish is mark+used+1
+                if look is not None:
+                    self._discard_frame(look)
+                    look = None
+                    outcome = "discarded"
+                self._rewind_unused_folds(frame, used)
         # The prefill phase runs AFTER the consume so admission sees every
         # slot and page freed by finishes inside the frame — exactly the
         # capacity the sync schedule's admission would see this step.  (Its
@@ -820,13 +861,34 @@ class Scheduler:
             self.num_lookahead_discarded += 1
         if (
             frame.rng_mark is not None
-            and self.runner._step == frame.rng_mark + 1
+            and self.runner._step == frame.rng_mark + frame.folds
         ):
+            # rewind EVERY in-loop fold the launch consumed (a megastep
+            # consumes horizon folds, one per column)
             self.runner.rng_restore(frame.rng_mark)
+        # the discarded horizon's device-computed columns are pure waste; the
+        # results are never fetched, so count the full requested width (an
+        # upper bound — the device may have early-exited sooner)
+        self.num_wasted_decode_tokens += frame.B_real * frame.horizon
         if frame.use_pen:
             for _slot, req, _expected in frame.lanes:
                 if req.sampling.has_penalties and not req.is_finished:
                     req.penalty_synced = False
+
+    def _rewind_unused_folds(self, frame: InFlightFrame, used: int) -> None:
+        """A finish trimmed a consumed megastep at column ``used-1``: the
+        launch consumed ``frame.folds`` key-counter values but the sync
+        schedule only folded ``used`` of them before recomposing the batch.
+        Rewind the tail so the relaunch (and any prefill fold before it)
+        lands on exactly the counter value the K=1 schedule would use.  The
+        guard mirrors ``_discard_frame``'s: rewind only while this frame's
+        folds are still the newest (any chained lookahead was discarded
+        first — LIFO rewinds)."""
+        if (
+            frame.rng_mark is not None
+            and self.runner._step == frame.rng_mark + frame.folds
+        ):
+            self.runner.rng_restore(frame.rng_mark + used)
 
     def drop_inflight(self) -> None:
         """Discard any pending frame (engine stop/drain, cache flush, or a
@@ -835,32 +897,110 @@ class Scheduler:
             self._discard_frame(self.inflight)
             self.inflight = None
 
+    def _token_finish(
+        self, sp, tok: int, out_len: int, total_len: int
+    ) -> FinishInfo | None:
+        """THE token-level finish rule, for one accepted decode token with
+        the post-acceptance counters (``out_len`` output tokens so far,
+        ``total_len`` prompt+output).  Single source of truth shared by
+        ``_accept_tokens`` (acceptance) and ``_host_finish_col`` (megastep
+        trim) — and mirrored on DEVICE by the done mask built in
+        ``_refresh_decode_state`` (stop_ids/limits); a rule added here must
+        be added there too, or the device loop will overrun the trim point
+        (wasted columns, never wrong streams — the host trim stays
+        authoritative)."""
+        if not sp.ignore_eos and tok in self.config.model.eos_token_ids:
+            return FinishInfo(reason="stop", matched_stop=tok)
+        if tok in sp.stop_token_ids:
+            return FinishInfo(reason="stop", matched_stop=tok)
+        if out_len >= sp.max_new_tokens:
+            return FinishInfo(reason="length")
+        if total_len >= self.sched.max_seq_len:
+            return FinishInfo(reason="length")
+        return None
+
+    def _host_finish_col(self, req: EngineRequest, row, horizon: int):
+        """First column of ``row`` (one lane's megastep tokens) that triggers
+        a finish under ``_token_finish``, or None — the host-side mirror of
+        the device done mask: the trim column it yields must match the
+        device's early-exit column, and the K-sweep parity tests pin the two
+        rule sets together."""
+        sp = req.sampling
+        out_len = len(req.output_ids)
+        total = req.total_len
+        for j in range(horizon):
+            # smglint: disable-next=HOTSYNC row was device_get-fetched in _consume_frame
+            tok = int(row[j])
+            out_len += 1
+            total += 1
+            if self._token_finish(sp, tok, out_len, total) is not None:
+                return j
+        return None
+
     def _consume_frame(
         self, frame: InFlightFrame, outputs: list[StepOutput]
-    ) -> float:
-        """Deferred fetch + host-side acceptance; returns seconds blocked on
-        the device.  ``jax.device_get`` is the EXPLICIT materialization of
-        the async results — the one intended device→host sync per steady
-        -state step, and the form the transfer guard permits."""
+    ) -> tuple[float, int]:
+        """Deferred fetch + host-side acceptance; returns (seconds blocked on
+        the device, columns accepted).  ``jax.device_get`` is the EXPLICIT
+        materialization of the async results — the one intended device→host
+        sync per steady-state step, and the form the transfer guard permits.
+
+        K=1 equivalence rule: acceptance stops at the EARLIEST finish column
+        across the batch.  Columns up to and including it were sampled with
+        the exact keys and batch composition the single-step schedule would
+        have used; everything past it belongs to a recomposed batch, so it
+        is discarded for every lane and the unused key folds are rewound by
+        the caller.  The device's done-mask early exit means those discarded
+        columns were (normally) never computed."""
         FAULTS.fire(
             "engine.device_fetch",
             rids=",".join(r.rid for _s, r, _e in frame.lanes),
         )
         t0 = time.perf_counter()
-        toks, lps = jax.device_get((frame.toks, frame.lps))
+        toks, lps, steps_run = jax.device_get(
+            (frame.toks, frame.lps, frame.steps_run)
+        )
         fetch_s = time.perf_counter() - t0
         if frame.lookahead:
             self.num_lookahead_kept += 1
-        self.num_decode_tokens += frame.B_real * frame.horizon
+        sr = int(steps_run) if steps_run is not None else frame.horizon
+        # host-side trim: earliest finish column across all lanes (scanning
+        # only device-computed columns — later ones hold unset zeros)
+        used = min(frame.horizon, sr) if sr > 0 else frame.horizon
+        finished_any = False
+        for idx, (_slot, req, _expected) in enumerate(frame.lanes):
+            col = self._host_finish_col(req, toks[idx], used)
+            if col is not None:
+                finished_any = True
+                if col + 1 < used:
+                    used = col + 1
+        self._step_horizon = frame.horizon
+        if sr < frame.horizon:
+            self.num_megastep_early_exits += 1
+        if sr > used:
+            # device computed past the accepted trim point (possible only if
+            # the device done rules lag the host's) — pure waste, normally 0
+            self.num_wasted_decode_tokens += (sr - used) * frame.B_real
+        self.num_decode_tokens += frame.B_real * used
         for idx, (_slot, req, _expected) in enumerate(frame.lanes):
             self._accept_tokens(
                 req,
-                [int(t) for t in toks[idx]],
-                [float(x) for x in lps[idx]],
+                [int(t) for t in toks[idx][:used]],
+                [float(x) for x in lps[idx][:used]],
                 outputs,
                 advance_seq=True,
             )
-        return fetch_s
+        # adaptive-horizon controller signal: EMA of decode columns between
+        # finishes — the expected uninterrupted run length K should track
+        self._cols_since_finish += used
+        if finished_any:
+            gap = float(self._cols_since_finish)
+            self._finish_gap_ema = (
+                gap if self._finish_gap_ema == 0.0
+                else 0.7 * self._finish_gap_ema + 0.3 * gap
+            )
+            self._cols_since_finish = 0
+        return fetch_s, used
 
     def _launch_lookahead(self, frame: InFlightFrame) -> InFlightFrame | None:
         """Chained launch for the step AFTER ``frame``, dispatched before
@@ -885,6 +1025,13 @@ class Scheduler:
             rids=",".join(r.rid for _s, r, _e in frame.lanes),
         )
         H = frame.horizon
+        # the chained frame re-evaluates the horizon controller (admission
+        # pressure / finish-rate/page headroom may have moved since the cold
+        # launch); forced-K=1 lane sets stay forced, so max_steps (and with
+        # it the compiled trace and stop-state signature) cannot flip
+        H2, max_steps = self._pick_horizon(
+            [(s, r) for s, r, _ in frame.lanes]
+        )
         ps = self.ps
         max_seq = self.sched.max_seq_len
         need = 0
@@ -896,17 +1043,17 @@ class Scheduler:
                 return None
             if req.total_len + H >= max_seq:
                 return None
-            limit = min(expected + 2 * H, max_seq)
+            limit = min(expected + H + H2, max_seq)
             have = len(req.shared_pages) + len(req.owned_pages)
             need += max(0, math.ceil(limit / ps) - have)
         if need > self.pool.free_count:
             return None
         for _slot, req, _expected in frame.lanes:
             # precheck guarantees allocation without eviction or preemption
-            if not self._ensure_seq_capacity(req, 2 * H):
+            if not self._ensure_seq_capacity(req, H + H2):
                 return None  # defensive; unreachable after the precheck
         mp_b = self._mp_bucket(max(
-            math.ceil(min(expected + 2 * H, max_seq) / ps)
+            math.ceil(min(expected + H + H2, max_seq) / ps)
             for _slot, _req, expected in frame.lanes
         ))
         positions = frame.positions + np.int32(H)
@@ -922,9 +1069,12 @@ class Scheduler:
         # transfer every launch, which the steady-state guard forbids
         last_col = lax.index_in_dim(frame.toks, frame.horizon - 1, axis=1,
                                     keepdims=False)
-        toks, lps = self.runner.decode_multi_async(
+        toks, lps, steps_run = self.runner.decode_multi_async(
             last_col, positions, ds.page_tables,
-            ds.temps, ds.topks, ds.topps, ds.minps, H,
+            ds.temps, ds.topks, ds.topps, ds.minps, H2,
+            max_steps=max_steps,
+            stop_state=(ds.stop_ids, ds.limits, ds.live)
+            if max_steps > 1 else None,
             pen=(ds.slot_idx, ds.freqs, ds.pres, ds.reps)
             if frame.use_pen else None,
             lora_idx=ds.lora_idx if frame.use_lora else None,
@@ -932,10 +1082,11 @@ class Scheduler:
         )
         return InFlightFrame(
             lanes=[(s, r, e + H) for s, r, e in frame.lanes],
-            toks=toks, lps=lps, horizon=H, B=frame.B, B_real=frame.B_real,
+            toks=toks, lps=lps, horizon=H2, B=frame.B, B_real=frame.B_real,
             mp_b=mp_b, positions=positions, lane_sig=frame.lane_sig,
             use_pen=frame.use_pen, use_lora=frame.use_lora,
             use_mrope=frame.use_mrope, rng_mark=mark, lookahead=True,
+            folds=H2, steps_run=steps_run,
         )
 
     # ---- admission / prefill (the per-step prefill phase) ----
@@ -1506,25 +1657,32 @@ class Scheduler:
         frame = self._launch_frame(active)
         if frame is not None:
             try:
-                self._consume_frame(frame, outputs)
+                _fetch_s, used = self._consume_frame(frame, outputs)
             except Exception:
                 # stash so the quarantine handler's drop_inflight rewinds
-                # this frame's sampling-key fold before any retry refolds
+                # this frame's sampling-key folds before any retry refolds
                 self.inflight = frame
                 raise
+            if used < frame.horizon:
+                # a finish trimmed the horizon: rewind the unused in-loop
+                # folds so the next launch continues the K=1 key sequence
+                self._rewind_unused_folds(frame, used)
 
     def _refresh_decode_state(
         self, active: list, B: int, mp_b: int,
         use_pen: bool, use_lora: bool, use_mrope: bool, sig: tuple,
+        stop_e: int = 0,
     ) -> DecodeState:
         """Bring the persistent device-resident decode inputs up to date.
 
-        Sampling params / penalty scalars / LoRA indices change only on batch
-        -composition change (``sig`` mismatch); page tables re-upload only on
-        composition change, mp_b bucket change, or after any host-side row
-        mutation (``_pages_dirty``).  Steady-state decode therefore re-uses
-        resident ``jax.Array``s — ``jnp.asarray`` in the runner is a no-op —
-        instead of ~10 host->device uploads per step."""
+        Sampling params / penalty scalars / LoRA indices / megastep stop
+        state (``stop_e`` > 0: per-lane stop-token id sets, absolute length
+        limits, live-lane mask) change only on batch-composition change
+        (``sig`` mismatch); page tables re-upload only on composition
+        change, mp_b bucket change, or after any host-side row mutation
+        (``_pages_dirty``).  Steady-state decode therefore re-uses resident
+        ``jax.Array``s — ``jnp.asarray`` in the runner is a no-op — instead
+        of ~10 host->device uploads per step."""
         ds = self._dstate
         S = self.sched.max_batch_size  # runner's garbage penalty-state row
         if ds.lane_sig != sig:
@@ -1565,6 +1723,31 @@ class Scheduler:
                 ds.reps = jnp.asarray(reps)
             ds.lora_idx = jnp.asarray(lora_idx) if use_lora else None
             ds.rope_delta = jnp.asarray(rope_delta) if use_mrope else None
+            if stop_e > 0:
+                # megastep device stop state: one upload per composition.
+                # stop_ids [B, E] (-1 padded; tokens are always >= 0 so the
+                # pad never matches), limits [B] = absolute total-length cap,
+                # live [B] marks real lanes (padded rows start "done")
+                eos_ids = tuple(self.config.model.eos_token_ids)
+                stop_ids = np.full((B, stop_e), -1, np.int32)
+                limits = np.full(B, 1, np.int32)
+                live = np.zeros(B, bool)
+                for idx, (_slot, req) in enumerate(active):
+                    sp = req.sampling
+                    ids = list(sp.stop_token_ids)
+                    if not sp.ignore_eos:
+                        ids.extend(eos_ids)
+                    stop_ids[idx, : len(ids)] = ids
+                    limits[idx] = min(
+                        req.prompt_len + sp.max_new_tokens,
+                        self.sched.max_seq_len,
+                    )
+                    live[idx] = True
+                ds.stop_ids = jnp.asarray(stop_ids)
+                ds.limits = jnp.asarray(limits)
+                ds.live = jnp.asarray(live)
+            else:
+                ds.stop_ids = ds.limits = ds.live = None
             ds.lane_sig = sig
             ds.pt_sig = None
         if use_pen:
@@ -1586,20 +1769,124 @@ class Scheduler:
             self._pages_dirty = False
         return ds
 
+    def _pick_horizon(self, active: list) -> tuple[int, int]:
+        """Choose this launch's decode horizon K and the compiled loop width
+        ``max_steps``; returns ``(K, max_steps)`` with ``K <= max_steps``.
+
+        Forced K=1 (``max_steps`` 1 too — these batches compile their own
+        lean trace, mirroring the overlap pipeline's sync-forcing paths):
+
+        - grammar-constrained lanes: the vocab mask is host-derived per
+          token, so the next device call depends on last step's host result;
+        - stop-string lanes: matches are found at the ENGINE layer after
+          detokenization — the device done mask cannot see them, and a
+          mid-horizon match would roll back emitted text.  Conservative by
+          design: any lane with stop strings forces K=1 (the "near-window"
+          refinement would need per-token detokenization to bound).
+
+        (Speculative decoding never reaches here — it forces the sync
+        scheduler path upstream.)
+
+        Pending admission work — a non-empty waiting queue or a resumable
+        ``PREFILLING`` slot — ALSO forces K=1, for byte-parity rather than
+        merely cadence: the K=1 schedule runs a prefill phase between every
+        two decode steps, so an admission (or a final resumable chunk) can
+        fold a key and join the decode batch between any two columns.  A
+        horizon spanning that point would compute its later columns with
+        yesterday's batch composition — tokens the single-step schedule
+        never produces.  (This is the megastep analogue of PR 4's "prefill
+        budget runs every step" rule; it is what lets the K-sweep parity
+        harness hold through chunked-prefill admissions mid-stream.)  These
+        batches keep the wide compiled trace (K=1 rides the dynamic loop
+        bound), so admission bursts don't retrace.  The rule samples the
+        queue at LAUNCH time, so a request submitted while a K-column frame
+        is already in flight waits up to K decode columns before its first
+        prefill chunk can run — bound the cap accordingly on TTFT-sensitive
+        deployments (the adaptive controller's finish-gap EMA does not see
+        arrival rate).
+
+        Otherwise the static path uses ``decode_horizon`` as-is, and the
+        adaptive controller (``adaptive_horizon``) starts from the cap and
+        halves K down by observed pressure: the finish-gap EMA (size K so
+        most horizons complete without a trim), page headroom (growing
+        every lane K tokens must fit free pages — never force an eviction
+        cascade just to run a bigger horizon), and the smallest remaining
+        per-lane token budget (a length finish is imminent; the early exit
+        makes overshoot free, but a tight K keeps the chained lookahead
+        launchable)."""
+        sched = self.sched
+        cap = sched.horizon_cap
+        forced = any(
+            r.token_filter is not None or r.sampling.stop
+            for _, r in active
+        )
+        if forced or cap <= 1:
+            return 1, 1
+        if self.waiting or any(
+            r is not None and r.status is RequestStatus.PREFILLING
+            for r in self.slots
+        ):
+            return 1, cap
+        if sched.adaptive_horizon:
+            k = cap
+            ema = self._finish_gap_ema
+            while k > 1 and ema > 0.0 and k > ema:
+                k //= 2
+            rem = min(
+                min(
+                    r.sampling.max_new_tokens - len(r.output_ids),
+                    self.sched.max_seq_len - r.total_len,
+                )
+                for _, r in active
+            )
+            k = max(1, min(k, rem))
+        else:
+            k = min(max(sched.decode_horizon, 1), cap)
+        # page-headroom clamp applies to the STATIC path too (parity, not
+        # just politeness): growing every lane K tokens must fit the free
+        # pool, else _ensure_seq_capacity would evict/preempt for a horizon
+        # the K=1 schedule never asks for — and a preemption refolds the
+        # victim's keys, diverging its stream at temperature > 0
+        ps = self.ps
+        while k > 1:
+            need = 0
+            for _, r in active:
+                limit = min(r.seq_len + k, sched.max_seq_len)
+                have = len(r.shared_pages) + len(r.owned_pages)
+                need += max(0, math.ceil(limit / ps) - have)
+            if need <= self.pool.free_count:
+                break
+            k //= 2
+        return k, cap
+
+    def _stop_id_width(self, active: list) -> int:
+        """Power-of-two width (>= 1) of the device stop-token id set: EOS
+        ids (unless ignore_eos) + per-request stop_token_ids, maxed over the
+        batch.  Part of the lane signature — a composition whose width
+        changes re-uploads the [B, E] id table (and compiles that E once)."""
+        eos = len(self.config.model.eos_token_ids)
+        n = 1
+        for _, r in active:
+            sp = r.sampling
+            ids = (0 if sp.ignore_eos else eos) + len(sp.stop_token_ids)
+            n = max(n, ids)
+        e = 1
+        while e < n:
+            e *= 2
+        return e
+
     def _launch_frame(self, active: list) -> InFlightFrame | None:
-        """Plan + dispatch one decode horizon for ``active`` slots; returns
+        """Plan + dispatch one decode megastep for ``active`` slots; returns
         the in-flight frame (results unmaterialized) or None when capacity
         pressure evicted every candidate."""
         FAULTS.fire(
             "engine.decode_step", rids=",".join(r.rid for _i, r in active)
         )
-        # constrained requests need a fresh host-derived vocab mask per token,
-        # so a batch containing one collapses the horizon to single-step
         use_mask = any(r.token_filter is not None for _, r in active)
         use_pen = any(r.sampling.has_penalties for _, r in active)
         use_lora = any(r.lora_idx for _, r in active)
         use_mrope = any(r.mrope_delta for _, r in active)
-        horizon = 1 if use_mask else max(self.sched.decode_horizon, 1)
+        horizon, max_steps = self._pick_horizon(active)
         # ensure pages exist for the whole horizon's KV writes; may preempt.
         # _ensure_seq_capacity refuses requests already evicted as a PEER's
         # preemption victim earlier in this pass (incl. by the spec leg).
@@ -1623,12 +1910,13 @@ class Scheduler:
             math.ceil(min(r.seq_len + horizon, self.sched.max_seq_len) / self.ps)
             for _, r in active
         ))
+        E = self._stop_id_width(active) if max_steps > 1 else 0
         sig = (
-            B, use_pen, use_lora, use_mrope,
+            B, use_pen, use_lora, use_mrope, max_steps, E,
             tuple((i, r.sched_serial) for i, r in active),
         )
         ds = self._refresh_decode_state(
-            active, B, mp_b, use_pen, use_lora, use_mrope, sig
+            active, B, mp_b, use_pen, use_lora, use_mrope, sig, stop_e=E
         )
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
@@ -1643,9 +1931,12 @@ class Scheduler:
             positions[idx] = mp_b * self.ps
 
         mark = self.runner.rng_mark()
-        toks, lps = self.runner.decode_multi_async(
+        toks, lps, steps_run = self.runner.decode_multi_async(
             tokens, positions, ds.page_tables,
             ds.temps, ds.topks, ds.topps, ds.minps, horizon,
+            max_steps=max_steps,
+            stop_state=(ds.stop_ids, ds.limits, ds.live)
+            if max_steps > 1 else None,
             pen=(ds.slot_idx, ds.freqs, ds.pres, ds.reps) if use_pen else None,
             mask=mask_arr,
             lora_idx=ds.lora_idx if use_lora else None,
@@ -1657,6 +1948,7 @@ class Scheduler:
             mp_b=mp_b, positions=positions, lane_sig=sig,
             use_pen=use_pen, use_lora=use_lora, use_mrope=use_mrope,
             rng_mark=mark, lookahead=False,
+            folds=horizon, steps_run=steps_run,
         )
 
     def _decode_speculative(self, active, outputs: list[StepOutput]):
@@ -1909,14 +2201,9 @@ class Scheduler:
             req.logprobs.append(lp)
             accepted.append(tok)
             accepted_lps.append(lp)
-            if not sp.ignore_eos and tok in self.config.model.eos_token_ids:
-                finish = FinishInfo(reason="stop", matched_stop=tok)
-            elif tok in sp.stop_token_ids:
-                finish = FinishInfo(reason="stop", matched_stop=tok)
-            elif len(req.output_ids) >= sp.max_new_tokens:
-                finish = FinishInfo(reason="length")
-            elif req.total_len >= self.sched.max_seq_len:
-                finish = FinishInfo(reason="length")
+            finish = self._token_finish(
+                sp, tok, len(req.output_ids), req.total_len
+            )
             if finish is not None:
                 break
         if self.flight is not None and accepted:

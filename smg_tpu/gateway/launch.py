@@ -74,6 +74,9 @@ def build_engine_from_args(args):
             max_batch_size=args.max_batch_size, max_seq_len=args.max_seq_len,
             max_prefill_tokens=getattr(args, "max_prefill_tokens", 4096),
             prefill_mix_policy=getattr(args, "prefill_mix_policy", "stall-free"),
+            decode_horizon=getattr(args, "decode_horizon", 1),
+            adaptive_horizon=getattr(args, "adaptive_horizon", "off") == "on",
+            decode_horizon_max=getattr(args, "decode_horizon_max", 0),
             speculative=getattr(args, "speculative", False),
             spec_max_draft=getattr(args, "spec_max_draft", 8),
             overlap_schedule=getattr(args, "overlap_schedule", "on") != "off",
