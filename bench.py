@@ -346,6 +346,30 @@ if __name__ == "__main__":
         )
     except Exception as e:
         gate = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # routing-decision probe (benches/bench_gateway.py --routing-probe):
+    # prefix-hit rate + prediction error, cache_aware vs round_robin on a
+    # Zipf multi-turn trace, and the decision-ring hot-path overhead cap
+    routing = None
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(_repo_root(), "benches", "bench_gateway.py"),
+             "--routing-probe"],
+            env=_sanitized_env(), cwd=_repo_root(), timeout=600,
+            stdout=subprocess.PIPE, text=True,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(f"bench_gateway exited {r.returncode}")
+        routing = {}
+        for line in r.stdout.strip().splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "bench" in rec:
+                routing[rec.pop("bench")] = rec
+    except Exception as e:
+        routing = {"error": f"{type(e).__name__}: {e}"[:200]}
     print(json.dumps({
         "metric": "tpu_unavailable",
         "value": 0.0,
@@ -355,5 +379,6 @@ if __name__ == "__main__":
                   "or the TPU bench child produced no result",
         "cpu_smoke": smoke,
         "engine_gate": gate,
+        "routing_probe": routing,
     }))
     sys.exit(1)

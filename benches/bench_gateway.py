@@ -125,9 +125,149 @@ def bench_json_fsm() -> None:
     timeit("json_fsm_accepts", lambda: m.accepts(doc[: len(doc) // 2]), 10000)
 
 
+def _zipf_multi_turn_trace(
+    rng, n_requests=2000, n_users=200, system_tokens=256, turn_tokens=61,
+):
+    """Zipf-ish multi-turn chat trace: a few hot users dominate, every
+    prompt = shared system prefix + the user's growing history + a fresh
+    turn (the workload cache-aware routing exists for).  Sizes model
+    production chat: a kilotoken-scale shared system prompt region and
+    ~60-token turns compounding into kilotoken prompts for hot users
+    (turn length deliberately NOT page-aligned, so reconciliation sees the
+    engine's page-granular rounding as honest small error)."""
+    system = [rng.randrange(32000) for _ in range(system_tokens)]
+    weights = [1.0 / (rank + 1) for rank in range(n_users)]
+    histories: dict[int, list[int]] = {}
+    trace = []
+    for _ in range(n_requests):
+        uid = rng.choices(range(n_users), weights=weights)[0]
+        hist = histories.setdefault(uid, list(system))
+        hist.extend(rng.randrange(32000) for _ in range(turn_tokens))
+        trace.append(list(hist))
+    return trace
+
+
+def bench_routing_decision_probe() -> None:
+    """Routing-decision observability probe (seed of ROADMAP item 2's fleet
+    bench): cache_aware vs round_robin on a Zipf multi-turn trace over a
+    simulated 8-worker fleet whose ground-truth caches are page-granular
+    radix trees.  Every dispatch reconciles the policy's predicted prefix
+    hit against the ground-truth cached tokens through the REAL
+    RouteObservability accounting, emitting prefix-hit rate and prediction
+    error; a separate timing pass caps the decision-ring overhead on the
+    selection hot path."""
+    from dataclasses import dataclass
+
+    from smg_tpu.gateway.observability import Metrics
+    from smg_tpu.kv_index import RadixTree
+    from smg_tpu.policies import RequestContext, get_policy
+
+    @dataclass
+    class W:
+        # carries the attrs the decision snapshot reads (gateway Worker
+        # parity — a double missing them would bench getattr's slow path)
+        worker_id: str
+        model_id: str = "m"
+        load: int = 0
+        healthy: bool = True
+        draining: bool = False
+        circuit: object = None
+
+        def is_available(self):
+            return True
+
+    page = 16
+    rng = random.Random(0)
+    trace = _zipf_multi_turn_trace(rng)
+
+    for name, kwargs in (
+        ("cache_aware", {"mode": "approx_token", "match_threshold": 0.05, "seed": 0}),
+        ("round_robin", {}),
+    ):
+        policy = get_policy(name, **kwargs)
+        metrics = Metrics()
+        metrics.route.attach("m", policy)
+        workers = [W(f"w{i}") for i in range(8)]
+        truth = RadixTree()  # ground-truth per-worker cache, page-granular
+        total_tokens = cached_tokens = 0
+        for toks in trace:
+            w, decision = policy.select(
+                workers, RequestContext(model_id="m", token_ids=toks)
+            )
+            actual = (truth.prefix_match(toks).get(w.worker_id, 0) // page) * page
+            metrics.route.reconcile(decision, w.worker_id, actual)
+            truth.insert(toks, w.worker_id)
+            total_tokens += len(toks)
+            cached_tokens += actual
+        recon = metrics.route.debug_router()["reconciliation"]
+        counts = sum(s["count"] for s in recon.values())
+        abs_err = sum(s["abs_error_sum"] for s in recon.values())
+        print(json.dumps({
+            "bench": f"routing_probe_{name}",
+            "requests": len(trace),
+            "prefix_hit_rate": round(cached_tokens / total_tokens, 4),
+            "mean_abs_prediction_error_tokens": round(abs_err / max(counts, 1), 2),
+            "reconciled": counts,
+        }))
+
+    # decision-ring overhead (acceptance: ≤2% on the routing hot path).
+    # The per-decision cost of select() over select_worker() is a FIXED
+    # ~µs-scale delta (RouteDecision + candidate snapshot + ring/counter
+    # fold), while a cache_aware radix walk over kilotoken prompts costs
+    # hundreds of µs with tens-of-µs run-to-run noise — so the delta is
+    # measured precisely on the cheapest policy (worst case: nothing hides
+    # it), interleaved min-of-rounds, and normalized against the measured
+    # cache_aware hot-path walk on the trace above.
+    fast = get_policy("round_robin")
+    Metrics().route.attach("m", fast)
+    workers = [W(f"w{i}") for i in range(8)]
+    fast_ctx = RequestContext(model_id="m", token_ids=list(range(64)))
+
+    def loop_us(fn, arg, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn(workers, arg)
+        return (time.perf_counter() - t0) / n * 1e6
+
+    import statistics
+
+    deltas = []
+    for _ in range(9):  # paired rounds: drift hits both sides of each pair
+        raw = loop_us(fast.select_worker, fast_ctx, 20000)
+        inst = loop_us(fast.select, fast_ctx, 20000)
+        deltas.append(inst - raw)
+    overhead_us = max(statistics.median(deltas), 0.0)
+
+    policy = get_policy("cache_aware", mode="approx_token",
+                        match_threshold=0.05, seed=0)
+    Metrics().route.attach("m", policy)
+    prompts = trace[-200:]
+    for toks in prompts:  # warm the tree so the walk does real work
+        policy.select(workers, RequestContext(model_id="m", token_ids=toks))
+    walks = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for toks in prompts:
+            policy.select_worker(
+                workers, RequestContext(model_id="m", token_ids=toks))
+        walks.append((time.perf_counter() - t0) / len(prompts) * 1e6)
+    hot_path_us = statistics.median(walks)
+
+    print(json.dumps({
+        "bench": "route_decision_overhead",
+        "decision_overhead_us": round(overhead_us, 2),
+        "hot_path_select_us": round(hot_path_us, 2),
+        "overhead_pct": round(overhead_us / hot_path_us * 100, 2),
+    }))
+
+
 if __name__ == "__main__":
+    if "--routing-probe" in sys.argv:  # bench.py embeds just this section
+        bench_routing_decision_probe()
+        sys.exit(0)
     bench_radix_trees()
     bench_tool_parser()
     bench_reasoning_parser()
     bench_policies()
     bench_json_fsm()
+    bench_routing_decision_probe()

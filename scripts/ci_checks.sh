@@ -27,7 +27,15 @@
 #                       under churn, dump-on-quarantine/watchdog/health-flip/
 #                       drain via faults.py, DumpFlight RPC + /debug/flight
 #                       end-to-end, TTFT failover attribution, /debug/slo
-#                       (tests/test_flight_recorder.py).
+#                       (tests/test_flight_recorder.py);
+#   7. routing decisions — gateway decision ring bound + schema,
+#                       predicted-vs-actual prefix-hit reconciliation incl.
+#                       a fault-injected stale kv index (gateway.kv_event),
+#                       KvEventMonitor degraded-mode metrics, /debug/router
+#                       + /debug/kv_index end-to-end over in-proc workers,
+#                       and per-policy RouteDecision records
+#                       (tests/test_route_observability.py + the decision
+#                       cases in tests/test_policies.py).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -58,5 +66,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_reliability.py -q \
 echo "== flight recorder / SLO accounting =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_flight_recorder.py -q \
     -m 'not slow' -p no:cacheprovider
+
+echo "== routing decision observability =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_route_observability.py \
+    tests/test_policies.py -q -m 'not slow' -p no:cacheprovider
 
 echo "ci_checks: all green"

@@ -27,6 +27,11 @@ Fault points (wired at the call sites listed):
                         (``engine/flight_recorder.py``) — proves a failing
                         postmortem dump degrades to a log line instead of
                         compounding the failure that triggered it
+``gateway.kv_event``    per kv-event batch in the gateway's KvEventMonitor
+                        subscription callback (``gateway/kv_events.py``) —
+                        an armed raise DROPS the batch, leaving the gateway
+                        kv_index stale (the reconciliation / drift-audit
+                        test seam), it never crashes the monitor
 =====================  =====================================================
 
 Trigger grammar (``arm()`` kwargs, or ``SMG_FAULTS`` entries):
@@ -65,6 +70,7 @@ FAULT_POINTS = (
     "worker.stream",
     "rpc.generate",
     "flight.dump",
+    "gateway.kv_event",
 )
 
 _MODES = ("always", "once", "after", "every")

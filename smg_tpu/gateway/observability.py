@@ -113,6 +113,13 @@ class Metrics:
         #: per-request SLO timeline accounting behind the three families
         #: above, plus the /debug/slo rolling summary with trace-id exemplars
         self.slo = SloTracker(self)
+        #: routing-plane observability: per-model decision rings behind
+        #: /debug/router, predicted-vs-actual prefix-hit reconciliation,
+        #: cache-index gauges, KvEventMonitor health families
+        #: (gateway/route_observability.py)
+        from smg_tpu.gateway.route_observability import RouteObservability
+
+        self.route = RouteObservability(self)
 
     def export(self) -> bytes:
         return generate_latest(self.registry)

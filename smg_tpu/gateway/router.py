@@ -141,6 +141,14 @@ class Router:
     def select_worker(
         self, ctx: RequestContext, exclude: set[str] = frozenset()
     ) -> Worker:
+        return self._select_with_decision(ctx, exclude=exclude)[0]
+
+    def _select_with_decision(
+        self, ctx: RequestContext, exclude: set[str] = frozenset()
+    ):
+        """(worker, RouteDecision) — the decision is recorded in the ring by
+        the policy's sink and held by dispatch paths so the first stream
+        chunk's ``cached_tokens`` can reconcile the predicted prefix hit."""
         workers = [
             w for w in self._candidate_workers(ctx.model_id)
             if w.worker_id not in exclude
@@ -150,10 +158,10 @@ class Router:
         if not workers:
             raise RouteError(503, "no workers available", "service_unavailable")
         policy = self.policies.policy_for(ctx.model_id)
-        worker = policy.select_worker(workers, ctx)
+        worker, decision = policy.select(workers, ctx)
         if worker is None:
             raise RouteError(503, "no healthy workers available", "service_unavailable")
-        return worker
+        return worker, decision
 
     def select_proxy_worker(self, model_id: str | None, ctx: RequestContext | None = None) -> Worker | None:
         """Policy-select among HTTP proxy-mode workers for ``model_id``
@@ -166,7 +174,7 @@ class Router:
         if not workers:
             return None
         policy = self.policies.policy_for(model_id)
-        return policy.select_worker(workers, ctx or RequestContext(model_id=model_id))
+        return policy.select(workers, ctx or RequestContext(model_id=model_id))[0]
 
     def select_pd_http_pair(
         self, model_id: str | None, ctx: RequestContext | None = None
@@ -186,8 +194,8 @@ class Router:
             return None
         policy = self.policies.policy_for(model_id)
         rc = ctx or RequestContext(model_id=model_id)
-        p = policy.select_worker(prefills, rc)
-        d = policy.select_worker(decodes, rc)
+        p = policy.select(prefills, rc)[0]
+        d = policy.select(decodes, rc)[0]
         if p is None or d is None:
             # a pool exists but nothing in it is selectable right now
             # (circuit open / draining): fall through to the other paths
@@ -363,7 +371,7 @@ class Router:
         )
         while True:
             try:
-                worker = self.select_worker(ctx, exclude=exclude)
+                worker, decision = self._select_with_decision(ctx, exclude=exclude)
             except RouteError:
                 if srec is not None:
                     srec.fail("rate_limited" if saw_queue_full else "error")
@@ -434,6 +442,12 @@ class Router:
                         if srec is not None:
                             srec.first_token(chunk.prompt_tokens,
                                              chunk.cached_tokens)
+                        # predicted-vs-actual prefix-hit reconciliation: the
+                        # engine's admission-time cached_tokens rides the
+                        # first chunk — fold it back into the decision ring
+                        self.metrics.route.reconcile(
+                            decision, worker.worker_id, chunk.cached_tokens
+                        )
                     if self.metrics is not None and chunk.output_tokens > last_output_tokens:
                         self.metrics.generated_tokens.inc(
                             chunk.output_tokens - last_output_tokens
@@ -555,7 +569,7 @@ class Router:
         if t_dispatch is None:
             t_dispatch = time.perf_counter()
         policy = self.policies.policy_for(ctx.model_id)
-        p_worker = policy.select_worker(prefill_pool, ctx)
+        p_worker = policy.select(prefill_pool, ctx)[0]
         if p_worker is None:
             raise RouteError(503, "no healthy prefill workers", "service_unavailable")
 
@@ -621,7 +635,7 @@ class Router:
                 logger.warning("kv offer %s signal failed", offer_uuid)
 
         try:
-            d_worker = policy.select_worker(decode_pool, ctx)
+            d_worker, d_decision = policy.select(decode_pool, ctx)
             if d_worker is None:
                 raise RouteError(503, "no healthy decode workers", "service_unavailable")
             if (
@@ -665,6 +679,15 @@ class Router:
                     if srec is not None:
                         srec.first_token(chunk.prompt_tokens,
                                          chunk.cached_tokens)
+                    # reconcile the decode-leg decision: adopt_prefilled
+                    # imports the prompt KV without consulting the decode
+                    # worker's prefix cache, so the engine honestly reports
+                    # cached_tokens=0 — a cache_aware prediction that fails to
+                    # materialize on the PD path lands as 'over', which is
+                    # exactly what the ring must show for PD traffic
+                    self.metrics.route.reconcile(
+                        d_decision, d_worker.worker_id, chunk.cached_tokens
+                    )
                 got_first_chunk = True
                 if self.metrics is not None and chunk.output_tokens > last_output_tokens:
                     self.metrics.generated_tokens.inc(

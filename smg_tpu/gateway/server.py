@@ -72,13 +72,18 @@ class AppContext:
         self.policies = PolicyRegistry(default=policy, **(policy_kwargs or {}))
         self.providers = ProviderRegistry()
         self.tokenizers = TokenizerRegistry()
-        self.kv_monitor = KvEventMonitor(self.registry, self.policies)
+        self.metrics = Metrics()
+        # routing decision ring + reconciliation: every policy instance
+        # (existing and lazily created per model) gets the sink
+        self.metrics.route.watch(self.policies)
+        self.kv_monitor = KvEventMonitor(
+            self.registry, self.policies, metrics=self.metrics
+        )
         from smg_tpu.gateway.router_manager import RouterManager
 
         # multi-model (IGW) coordination: per-model routers over shared
         # registries; ``self.router`` stays the default instance so
         # single-model deployments and existing call sites are unchanged
-        self.metrics = Metrics()
         self.routers = RouterManager(
             self.registry, self.policies, self.tokenizers, router_config,
             metrics=self.metrics,
@@ -550,6 +555,10 @@ def build_app(ctx: AppContext, client_max_size: int = 256 * 2**20) -> web.Applic
     # observability.SloTracker): worker black-box dumps + rolling SLO summary
     app.router.add_get("/debug/flight/{worker_id}", h_debug_flight)
     app.router.add_get("/debug/slo", h_debug_slo)
+    # routing-plane observability (gateway/route_observability.py): decision
+    # ring + reconciliation, and the gateway-vs-worker kv-index drift audit
+    app.router.add_get("/debug/router", h_debug_router)
+    app.router.add_get("/debug/kv_index", h_debug_kv_index)
     app.router.add_get("/health", h_health)
     app.router.add_get("/liveness", h_health)
     app.router.add_get("/readiness", h_readiness)
@@ -673,6 +682,113 @@ async def h_debug_slo(request: web.Request) -> web.Response:
     with trace-id exemplars (observability.SloTracker)."""
     ctx: AppContext = request.app["ctx"]
     return web.json_response(ctx.metrics.slo.summary())
+
+
+async def h_debug_router(request: web.Request) -> web.Response:
+    """Routing decision ring + predicted-vs-actual reconciliation: bounded,
+    schema-stable per-model decision records (policy, candidates with
+    loads/breaker states, prefix matches, threshold/imbalance outcome,
+    tie-break, decision latency) and per-worker prediction-error aggregates
+    (``gateway/route_observability.py``).  ``?model=`` filters,
+    ``?limit=`` bounds records per model (default 64)."""
+    ctx: AppContext = request.app["ctx"]
+    try:
+        limit = int(request.query.get("limit", 64))
+    except ValueError:
+        return _error(400, "limit must be an integer")
+    return web.json_response(
+        ctx.metrics.route.debug_router(
+            model=request.query.get("model"), limit=limit
+        )
+    )
+
+
+# radix-relevant subset of worker loads() used by the kv-index drift audit
+_KV_AUDIT_LOAD_KEYS = (
+    "cached_pages", "total_pages", "free_pages", "radix_hit_pages",
+    "radix_miss_pages", "radix_evicted_pages", "cached_prompt_tokens",
+    "computed_prompt_tokens", "cache_hit_rate",
+)
+
+
+async def h_debug_kv_index(request: web.Request) -> web.Response:
+    """KV-index drift audit: the gateway's cache-index state (RadixTree /
+    PositionalIndexer per model) side by side with each worker's
+    ``loads()``-reported radix stats, flagging event-mode divergence (the
+    gateway mirror claiming materially more or fewer blocks than the worker
+    actually caches).  ``?drift_ratio=`` (default 0.25) and ``?min_abs=``
+    (default 4 blocks) tune the flag thresholds."""
+    ctx: AppContext = request.app["ctx"]
+    try:
+        drift_ratio = float(request.query.get("drift_ratio", 0.25))
+        min_abs = int(request.query.get("min_abs", 4))
+    except ValueError:
+        return _error(400, "drift_ratio/min_abs must be numeric")
+    gateway_view = ctx.metrics.route.kv_index_snapshot()
+
+    async def _loads(w):
+        # per-worker timeout (like /scheduler): one black-holed remote
+        # worker must not wedge the audit endpoint
+        try:
+            return w.worker_id, await asyncio.wait_for(w.client.get_loads(), 2.0)
+        except Exception as e:
+            return w.worker_id, {"error": str(e)}
+
+    all_workers = ctx.registry.list()
+    results = dict(await asyncio.gather(*(_loads(w) for w in all_workers)))
+    workers = {
+        wid: (
+            loads if "error" in loads
+            else {k: loads[k] for k in _KV_AUDIT_LOAD_KEYS if k in loads}
+        )
+        for wid, loads in results.items()
+    }
+
+    audit = []
+    for model_key, stats in gateway_view.items():
+        if "error" in stats:
+            continue
+        # scope each policy's audit to the workers that actually feed its
+        # index: KvEventMonitor subscribes a worker to policy_for(model_id),
+        # so a worker with its own model key never populates the __default__
+        # indexer — pairing them would flag phantom drift in multi-model
+        # deployments
+        pool = [
+            w for w in all_workers
+            if (w.model_id or "__default__") == model_key
+        ]
+        per_worker_blocks = (stats.get("indexer") or {}).get(
+            "per_worker_blocks", {}
+        )
+        for w in pool:
+            loads = workers.get(w.worker_id, {})
+            cached_pages = loads.get("cached_pages")
+            entry = {
+                "model": model_key,
+                "worker_id": w.worker_id,
+                "mode": stats.get("mode"),
+                "gateway_blocks": per_worker_blocks.get(w.worker_id, 0),
+                "worker_cached_pages": cached_pages,
+                "drift_blocks": None,
+                "drift_ratio": None,
+                "flagged": False,
+            }
+            if stats.get("mode") == "event" and cached_pages is not None:
+                gw_blocks = entry["gateway_blocks"]
+                drift = gw_blocks - cached_pages
+                ratio = abs(drift) / max(gw_blocks, cached_pages, 1)
+                entry["drift_blocks"] = drift
+                entry["drift_ratio"] = ratio
+                entry["flagged"] = ratio > drift_ratio and abs(drift) >= min_abs
+            audit.append(entry)
+
+    return web.json_response({
+        "schema_version": 1,
+        "gateway": gateway_view,
+        "workers": workers,
+        "audit": audit,
+        "thresholds": {"drift_ratio": drift_ratio, "min_abs": min_abs},
+    })
 
 
 async def h_health(request: web.Request) -> web.Response:

@@ -152,6 +152,16 @@ class NativeRadixTree:
     def size(self) -> int:
         return self._lib.rt_size(self._tree)
 
+    def stats(self) -> dict:
+        """Python-tree-compatible stats; the C++ tree exposes element count
+        only (node/eviction counters stay None — collectors skip them)."""
+        return {
+            "elements": self.size,
+            "nodes": None,
+            "evicted_elements": None,
+            "max_size": None,
+        }
+
 
 def make_radix_tree(max_size: int = 2**20):
     """Factory: native tree when available, Python tree otherwise."""

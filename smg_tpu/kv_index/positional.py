@@ -33,13 +33,21 @@ class PositionalIndexer:
         self._blocks: dict[int, set[str]] = defaultdict(set)
         # worker -> set of block hashes (for removal / worker eviction)
         self._worker_blocks: dict[str, set[int]] = defaultdict(set)
+        # event accounting for the kv-index drift audit: how much churn the
+        # gateway mirror has absorbed (vs what workers report via loads())
+        self.num_batches_applied = 0
+        self.num_blocks_stored = 0
+        self.num_blocks_removed = 0
+        self.num_clears = 0
 
     def apply_batch(self, worker_id: str, batch: KvEventBatch) -> None:
+        self.num_batches_applied += 1
         for ev in batch.events:
             if isinstance(ev, BlockStored):
                 for h in ev.block_hashes:
                     self._blocks[h].add(worker_id)
                     self._worker_blocks[worker_id].add(h)
+                self.num_blocks_stored += len(ev.block_hashes)
             elif isinstance(ev, BlockRemoved):
                 for h in ev.block_hashes:
                     s = self._blocks.get(h)
@@ -48,7 +56,9 @@ class PositionalIndexer:
                         if not s:
                             self._blocks.pop(h, None)
                     self._worker_blocks[worker_id].discard(h)
+                self.num_blocks_removed += len(ev.block_hashes)
             elif isinstance(ev, AllBlocksCleared):
+                self.num_clears += 1
                 self.remove_worker(worker_id)
 
     def remove_worker(self, worker_id: str) -> None:
@@ -125,4 +135,12 @@ class PositionalIndexer:
         return {
             "blocks": len(self._blocks),
             "workers": len(self._worker_blocks),
+            "per_worker_blocks": {
+                w: len(s) for w, s in self._worker_blocks.items()
+            },
+            "batches_applied": self.num_batches_applied,
+            "blocks_stored": self.num_blocks_stored,
+            "blocks_removed": self.num_blocks_removed,
+            "clears": self.num_clears,
+            "page_size": self.page_size,
         }

@@ -33,9 +33,20 @@ class RadixTree:
         self.max_size = max_size  # total elements stored
         self._size = 0
         self._clock = itertools.count()
+        self.num_nodes = 0  # non-root nodes
+        self.num_evicted_elements = 0
 
     def _tick(self) -> int:
         return next(self._clock)
+
+    def stats(self) -> dict:
+        """Index accountability snapshot (/debug/kv_index, cache gauges)."""
+        return {
+            "elements": self._size,
+            "nodes": self.num_nodes,
+            "evicted_elements": self.num_evicted_elements,
+            "max_size": self.max_size,
+        }
 
     def insert(self, seq, worker_id: str) -> None:
         seq = tuple(seq)
@@ -51,6 +62,7 @@ class RadixTree:
                 new.workers[worker_id] = tick
                 node.children[head] = new
                 self._size += len(new.key)
+                self.num_nodes += 1
                 break
             # find common prefix length with child.key
             k = child.key
@@ -67,6 +79,7 @@ class RadixTree:
                 mid.workers = dict(child.workers)
                 node.children[head] = mid
                 child = mid
+                self.num_nodes += 1
             child.workers[worker_id] = tick
             node = child
             i += p
@@ -127,6 +140,8 @@ class RadixTree:
             del parent.children[victim.key[0]]
             freed += len(victim.key)
             self._size -= len(victim.key)
+            self.num_nodes -= 1
+            self.num_evicted_elements += len(victim.key)
             if parent is not self.root and not parent.children:
                 heapq.heappush(
                     heap, (max(parent.workers.values(), default=-1), id(parent), parent)

@@ -2,10 +2,21 @@
 SURVEY.md §2.1: 10 policies + registry behind ``trait LoadBalancingPolicy``).
 """
 
-from smg_tpu.policies.base import Policy, PolicyRegistry, RequestContext, get_policy
+from smg_tpu.policies.base import (
+    DECISION_KEYS,
+    DECISION_SCHEMA_VERSION,
+    Policy,
+    PolicyRegistry,
+    RequestContext,
+    RouteDecision,
+    get_policy,
+)
 # import modules for registration side effects
 from smg_tpu.policies import simple as _simple  # noqa: F401
 from smg_tpu.policies import hashing as _hashing  # noqa: F401
 from smg_tpu.policies import cache_aware as _cache_aware  # noqa: F401
 
-__all__ = ["Policy", "PolicyRegistry", "RequestContext", "get_policy"]
+__all__ = [
+    "DECISION_KEYS", "DECISION_SCHEMA_VERSION", "Policy", "PolicyRegistry",
+    "RequestContext", "RouteDecision", "get_policy",
+]

@@ -52,12 +52,31 @@ class CacheAwarePolicy(Policy):
         # inserts so peers can mirror them; remote applies bypass the hooks
         self._insert_hooks: list = []
         self._rng = _random.Random(seed)
+        self.num_inserted_prefixes = 0  # local + remote tree inserts
+
+    def stats(self) -> dict:
+        """Gateway cache-index snapshot (decision-ring / /debug/kv_index /
+        metric-collector surface): tree size + eviction stats, positional
+        indexer block counts incl. per-worker."""
+        tree = self.tree
+        tree_stats = (
+            tree.stats() if hasattr(tree, "stats")
+            else {"elements": getattr(tree, "size", None)}
+        )
+        return {
+            "mode": self.mode,
+            "match_threshold": self.match_threshold,
+            "inserted_prefixes": self.num_inserted_prefixes,
+            "tree": tree_stats,
+            "indexer": self.indexer.stats(),
+        }
 
     # event-mode feed (wired to KvEventMonitor)
     def apply_kv_events(self, worker_id: str, batch) -> None:
         self.indexer.apply_batch(worker_id, batch)
 
     def on_worker_removed(self, worker_id: str) -> None:
+        super().on_worker_removed(worker_id)
         self.tree.remove_worker(worker_id)
         self.indexer.remove_worker(worker_id)
 
@@ -66,7 +85,19 @@ class CacheAwarePolicy(Policy):
             return ctx.text or (",".join(map(str, ctx.token_ids or [])))
         return ctx.token_ids if ctx.token_ids is not None else (ctx.text or "")
 
-    def select_worker(self, workers, ctx):
+    def _predicted_tokens(self, match_elems: int, seq_len: int, ctx) -> int | None:
+        """Predicted prefix overlap in TOKEN space for reconciliation against
+        engine-reported ``cached_tokens``.  event/approx_token match in
+        tokens already; approx_string matches chars, scaled through the
+        tokenized length when the router provides it (approximate by
+        construction — exactly the error the reconciliation quantifies)."""
+        if self.mode != "approx_string":
+            return match_elems if ctx.token_ids is not None else None
+        if not ctx.token_ids or seq_len <= 0:
+            return None
+        return int(round(match_elems / seq_len * len(ctx.token_ids)))
+
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
@@ -78,8 +109,12 @@ class CacheAwarePolicy(Policy):
         )
 
         seq = self._request_seq(ctx)
+        seq_len = len(seq) if seq is not None else 0
         chosen = None
-        if not imbalanced and seq is not None and len(seq) > 0:
+        outcome = "no_match"
+        tie_break = None
+        matches: dict = {}
+        if not imbalanced and seq is not None and seq_len > 0:
             if self.mode == "event":
                 matches = self.indexer.match(list(seq)) if ctx.token_ids else {}
             else:
@@ -87,22 +122,53 @@ class CacheAwarePolicy(Policy):
             matches = {w: m for w, m in matches.items() if w in loads}
             if matches:
                 best_len = max(matches.values())
-                if best_len / max(len(seq), 1) >= self.match_threshold:
+                if best_len / max(seq_len, 1) >= self.match_threshold:
                     best = [w for w, m in matches.items() if m == best_len]
                     # ties: least load, then smallest worker id for stability
                     wid = min(best, key=lambda w: (loads[w], w))
                     chosen = next(w for w in avail if w.worker_id == wid)
+                    outcome = "prefix_hit"
+                    tie_break = (
+                        f"load_then_id_among_{len(best)}"
+                        if len(best) > 1 else "unique_best"
+                    )
+                else:
+                    outcome = "below_threshold"
+        elif imbalanced:
+            outcome = "imbalance_override"
         if chosen is None:
             min_l = min(loads.values())
             cands = [w for w in avail if w.load == min_l]
             chosen = self._rng.choice(cands)
-        if self.mode != "event" and seq is not None and len(seq) > 0:
+            if tie_break is None:
+                tie_break = f"random_among_{len(cands)}_min_load"
+        if self.mode != "event" and seq is not None and seq_len > 0:
             self.tree.insert(seq, chosen.worker_id)
+            self.num_inserted_prefixes += 1
             for hook in self._insert_hooks:
                 try:
                     hook(seq, chosen.worker_id)
                 except Exception:  # replication must never fail routing
                     pass
+        if decision is not None:
+            decision.mode = self.mode
+            decision.match_threshold = self.match_threshold
+            decision.imbalanced = imbalanced
+            decision.outcome = outcome
+            decision.tie_break = tie_break
+            decision.prefix_matches = matches
+            match_at_chosen = matches.get(chosen.worker_id, 0)
+            decision.predicted_match_fraction = (
+                match_at_chosen / seq_len if seq_len else 0.0
+            )
+            # imbalance override skips the index walk entirely: there is no
+            # prediction to reconcile, and folding an implicit 0 into the
+            # per-worker staleness EMA would blame the index for a decision
+            # it never made
+            decision.predicted_match_tokens = (
+                None if imbalanced
+                else self._predicted_tokens(match_at_chosen, seq_len, ctx)
+            )
         return chosen
 
     # ---- mesh tree_sync surface (reference: mesh/adapters/tree_sync.rs) ----
@@ -114,3 +180,4 @@ class CacheAwarePolicy(Policy):
         """Insert a peer-routed prefix without re-firing replication hooks."""
         if self.mode != "event" and seq is not None and len(seq) > 0:
             self.tree.insert(seq, worker_id)
+            self.num_inserted_prefixes += 1

@@ -36,7 +36,7 @@ class ConsistentHashingPolicy(Policy):
         self._ring = ring
         self._ring_workers = worker_ids
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
@@ -51,6 +51,9 @@ class ConsistentHashingPolicy(Policy):
         if idx == len(self._ring):
             idx = 0
         wid = self._ring[idx][1]
+        if decision is not None:
+            decision.outcome = "hash_ring"
+            decision.tie_break = f"vnode:{idx}"
         return next(w for w in avail if w.worker_id == wid)
 
 
@@ -64,7 +67,7 @@ class PrefixHashPolicy(Policy):
     def __init__(self, prefix_tokens: int = 256):
         self.prefix_tokens = prefix_tokens
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
@@ -72,4 +75,6 @@ class PrefixHashPolicy(Policy):
             key = b"".join(int(t).to_bytes(4, "little") for t in ctx.token_ids[: self.prefix_tokens])
         else:
             key = (ctx.text or "")[: self.prefix_tokens * 4].encode()
+        if decision is not None:
+            decision.outcome = "prefix_hash"
         return avail[_h(key) % len(avail)]

@@ -23,11 +23,15 @@ class RoundRobinPolicy(Policy):
     def __init__(self):
         self._counter = itertools.count()
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
-        return avail[next(self._counter) % len(avail)]
+        idx = next(self._counter) % len(avail)
+        if decision is not None:
+            decision.outcome = "round_robin"
+            decision.tie_break = f"cursor:{idx}"
+        return avail[idx]
 
 
 @register_policy
@@ -37,9 +41,13 @@ class RandomPolicy(Policy):
     def __init__(self, seed: int | None = None):
         self._rng = _random.Random(seed)
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
-        return self._rng.choice(avail) if avail else None
+        if not avail:
+            return None  # outcome stays "none" -> select() labels "no_worker"
+        if decision is not None:
+            decision.outcome = "random"
+        return self._rng.choice(avail)
 
 
 @register_policy
@@ -52,12 +60,17 @@ class LeastLoadPolicy(Policy):
     def __init__(self, seed: int | None = None):
         self._rng = _random.Random(seed)
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
         min_load = min(w.load for w in avail)
         best = [w for w in avail if w.load == min_load]
+        if decision is not None:
+            decision.outcome = "least_load"
+            decision.tie_break = (
+                f"random_among_{len(best)}" if len(best) > 1 else "unique_min"
+            )
         return self._rng.choice(best)
 
 
@@ -70,14 +83,21 @@ class PowerOfTwoPolicy(Policy):
     def __init__(self, seed: int | None = None):
         self._rng = _random.Random(seed)
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
         if len(avail) == 1:
+            if decision is not None:
+                decision.outcome = "power_of_two"
+                decision.tie_break = "single_candidate"
             return avail[0]
         a, b = self._rng.sample(avail, 2)
-        return a if a.load <= b.load else b
+        chosen = a if a.load <= b.load else b
+        if decision is not None:
+            decision.outcome = "power_of_two"
+            decision.tie_break = f"sampled:{a.worker_id},{b.worker_id}"
+        return chosen
 
 
 @register_policy
@@ -86,9 +106,13 @@ class PassthroughPolicy(Policy):
 
     name = "passthrough"
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
-        return avail[0] if avail else None
+        if not avail:
+            return None  # outcome stays "none" -> select() labels "no_worker"
+        if decision is not None:
+            decision.outcome = "passthrough"
+        return avail[0]
 
 
 @register_policy
@@ -105,18 +129,22 @@ class ManualPolicy(Policy):
         self._rng = _random.Random(seed)
         self._lock = threading.Lock()
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
         key = ctx.routing_key
         if not key:
+            if decision is not None:
+                decision.outcome = "sticky_no_key"
             return self._rng.choice(avail)
         by_id = {w.worker_id: w for w in avail}
         with self._lock:
             wid = self._assignments.get(key)
             if wid in by_id:
                 self._assignments.move_to_end(key)
+                if decision is not None:
+                    decision.outcome = "sticky_hit"
                 return by_id[wid]
             # (re)assign: least-loaded
             chosen = min(avail, key=lambda w: w.load)
@@ -124,9 +152,13 @@ class ManualPolicy(Policy):
             self._assignments.move_to_end(key)
             while len(self._assignments) > self._max_keys:
                 self._assignments.popitem(last=False)
+            if decision is not None:
+                decision.outcome = "sticky_assign"
+                decision.tie_break = "least_load"
             return chosen
 
     def on_worker_removed(self, worker_id: str) -> None:
+        super().on_worker_removed(worker_id)
         with self._lock:
             for k in [k for k, v in self._assignments.items() if v == worker_id]:
                 del self._assignments[k]
@@ -150,7 +182,7 @@ class BucketPolicy(Policy):
                 return i
         return len(self.boundaries)
 
-    def select_worker(self, workers, ctx):
+    def select_worker(self, workers, ctx, decision=None):
         avail = self.available(workers)
         if not avail:
             return None
@@ -159,4 +191,9 @@ class BucketPolicy(Policy):
         bucket = self._bucket_of(n)
         stripe = [w for i, w in enumerate(avail) if i % n_buckets == bucket]
         pool = stripe or avail
+        if decision is not None:
+            decision.outcome = "bucket"
+            decision.tie_break = (
+                f"bucket:{bucket}" if stripe else f"bucket:{bucket}:empty_stripe"
+            )
         return min(pool, key=lambda w: w.load)

@@ -143,6 +143,34 @@ def test_pd_chat_through_gateway(pd_gateway):
     assert pd_gateway.d_engine.scheduler.num_decode_tokens > 0
 
 
+def test_pd_decode_decision_reconciles(pd_gateway):
+    """The decode-leg RouteDecision is held across PD dispatch and reconciled
+    against the first decode chunk's cached_tokens — adopt_prefilled imports
+    the prompt KV without consulting the decode worker's prefix cache, so
+    the honest actual is 0 (regression: _execute_pd used to drop the
+    decision, leaving PD traffic out of the reconciliation accounting)."""
+    async def go():
+        resp = await pd_gateway.client.post(
+            "/v1/chat/completions",
+            json={"model": "tiny-test",
+                  "messages": [{"role": "user", "content": "w11 w12 w13"}],
+                  "max_tokens": 2, "temperature": 0, "ignore_eos": True},
+        )
+        assert resp.status == 200, await resp.text()
+        dbg = await pd_gateway.client.get("/debug/router")
+        assert dbg.status == 200
+        return await dbg.json()
+
+    body = pd_gateway.run(go())
+    reconciled = [
+        d for d in body["models"]["tiny-test"]["decisions"]
+        if d["reconciled"] and d["chosen"] == "decode-0"
+    ]
+    assert reconciled, "PD decode decision never reconciled"
+    assert reconciled[-1]["worker_cached_tokens"] == 0
+    assert body["reconciliation"]["decode-0"]["count"] >= 1
+
+
 def test_pd_streaming(pd_gateway):
     async def go():
         resp = await pd_gateway.client.post(

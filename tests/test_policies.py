@@ -191,3 +191,145 @@ def test_native_radix_parity_with_python():
     for _ in range(50):
         probe = seqs[rng.randrange(len(seqs))]
         assert py.prefix_match(probe) == nat.prefix_match(probe)
+
+
+# ---- routing decision records (gateway/route_observability.py consumes) ----
+
+
+ALL_POLICY_NAMES = (
+    "round_robin", "random", "least_load", "power_of_two", "passthrough",
+    "manual", "consistent_hashing", "prefix_hash", "bucket", "cache_aware",
+)
+
+
+def test_every_policy_emits_schema_stable_decision():
+    """select() returns (worker, RouteDecision) for EVERY registered policy,
+    and to_dict() holds exactly the pinned schema keys (dashboards pin
+    against DECISION_KEYS; extend, never rename)."""
+    from smg_tpu.policies import DECISION_KEYS, RouteDecision
+
+    for name in ALL_POLICY_NAMES:
+        p = get_policy(name)
+        ws = workers(4)
+        w, d = p.select(
+            ws, ctx(token_ids=list(range(32)), routing_key="k", request_id="r1")
+        )
+        assert w is not None, name
+        assert isinstance(d, RouteDecision), name
+        assert d.policy == name
+        assert d.chosen == w.worker_id, name
+        assert d.outcome not in ("", "none"), name
+        assert d.decision_us > 0, name
+        assert d.request_id == "r1"
+        # candidate snapshot covers the full pool
+        assert {c[0] for c in d.candidates} == {x.worker_id for x in ws}, name
+        assert set(d.to_dict()) == set(DECISION_KEYS), name
+
+
+@pytest.mark.parametrize("name", ALL_POLICY_NAMES)
+def test_decision_no_worker_outcome(name):
+    """EVERY policy labels an empty-pool selection 'no_worker' — dashboards
+    alert on that outcome, so a policy stamping its own name before the
+    availability check (the random/passthrough regression) hides outages."""
+    p = get_policy(name)
+    ws = workers(2)
+    for w in ws:
+        w.healthy = False
+    w, d = p.select(ws, ctx(token_ids=list(range(8)), routing_key="k"))
+    assert w is None, name
+    assert d.chosen is None, name
+    assert d.outcome == "no_worker", name
+
+
+def test_cache_oblivious_policy_predicts_zero_reuse():
+    """round_robin has no cache model: its implicit prediction is 0 cached
+    tokens, so reconciliation measures what cache-oblivious routing leaves
+    on the table."""
+    p = get_policy("round_robin")
+    w, d = p.select(workers(2), ctx(token_ids=list(range(16))))
+    assert d.predicted_match_tokens == 0
+    # text-only requests have no token-space prediction to reconcile
+    _, d2 = p.select(workers(2), ctx(text="hello"))
+    assert d2.predicted_match_tokens is None
+
+
+def test_cache_aware_decision_prefix_hit_fields():
+    p = get_policy("cache_aware", mode="approx_token", match_threshold=0.3, seed=0)
+    ws = workers(4)
+    prefix = list(range(100))
+    first, d0 = p.select(ws, ctx(token_ids=prefix))
+    assert d0.mode == "approx_token"
+    assert d0.outcome in ("no_match", "below_threshold")  # cold tree
+    assert d0.predicted_match_tokens in (0, None) or d0.predicted_match_tokens >= 0
+    again, d = p.select(ws, ctx(token_ids=prefix + [500]))
+    assert again.worker_id == first.worker_id
+    assert d.outcome == "prefix_hit"
+    assert d.prefix_matches[first.worker_id] == 100
+    assert d.predicted_match_tokens == 100
+    assert 0.9 < d.predicted_match_fraction <= 1.0
+    assert d.match_threshold == 0.3
+    assert d.tie_break in ("unique_best",) or d.tie_break.startswith("load_then_id")
+
+
+def test_cache_aware_decision_imbalance_override():
+    p = get_policy(
+        "cache_aware", mode="approx_token", imbalance_abs=4, imbalance_rel=1.2, seed=0
+    )
+    ws = workers(2)
+    prefix = list(range(64))
+    first, _ = p.select(ws, ctx(token_ids=prefix))
+    first.load = 50
+    pick, d = p.select(ws, ctx(token_ids=prefix))
+    assert pick.worker_id != first.worker_id
+    assert d.imbalanced is True
+    assert d.outcome == "imbalance_override"
+    # the override skips the index walk: no prediction exists, so the
+    # decision must NOT reconcile (an implicit 0 would corrupt the
+    # per-worker index-staleness EMA with decisions the index never made)
+    assert d.predicted_match_tokens is None
+
+
+def test_cache_aware_decision_below_threshold():
+    p = get_policy("cache_aware", mode="approx_token", match_threshold=0.9, seed=0)
+    ws = workers(2)
+    p.select(ws, ctx(token_ids=list(range(100))))
+    # 32/132 ≈ 24% overlap < 90% threshold: match exists but is rejected
+    _, d = p.select(ws, ctx(token_ids=list(range(32)) + list(range(900, 1000))))
+    assert d.outcome == "below_threshold"
+    assert d.predicted_match_tokens is not None
+
+
+def test_cache_aware_approx_string_scales_prediction_to_tokens():
+    p = get_policy("cache_aware", mode="approx_string", match_threshold=0.1, seed=0)
+    ws = workers(2)
+    toks = list(range(40))
+    first, _ = p.select(ws, ctx(text="abcd" * 25, token_ids=toks))
+    _, d = p.select(ws, ctx(text="abcd" * 25, token_ids=toks))
+    if d.outcome == "prefix_hit":
+        # char-space match rescaled through the tokenized length
+        assert d.predicted_match_tokens == len(toks)
+
+
+def test_decision_sink_receives_records_and_failures_never_break_routing():
+    from smg_tpu.policies import RouteDecision
+
+    class Sink:
+        def __init__(self):
+            self.records = []
+
+        def record(self, d):
+            self.records.append(d)
+
+    p = get_policy("least_load", seed=0)
+    sink = Sink()
+    p._decision_sink = sink
+    w, d = p.select(workers(3), ctx())
+    assert sink.records == [d]
+
+    class BrokenSink:
+        def record(self, d):
+            raise RuntimeError("observability must never fail routing")
+
+    p._decision_sink = BrokenSink()
+    w2, _ = p.select(workers(3), ctx())
+    assert w2 is not None

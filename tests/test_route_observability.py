@@ -1,0 +1,524 @@
+"""Routing decision observability (gateway/route_observability.py): decision
+ring bound + schema, predicted-vs-actual reconciliation (incl. a
+fault-injected stale kv index via smg_tpu/faults.py), KvEventMonitor health
+metrics, and /debug/router + /debug/kv_index end-to-end over in-proc
+workers — the gateway-side twin of tests/test_flight_recorder.py."""
+
+import asyncio
+import threading
+from dataclasses import dataclass
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from prometheus_client import generate_latest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.faults import FAULTS
+from smg_tpu.gateway.kv_events import KvEventMonitor
+from smg_tpu.gateway.observability import Metrics
+from smg_tpu.gateway.server import AppContext, build_app
+from smg_tpu.gateway.worker_client import InProcWorkerClient, WorkerClient
+from smg_tpu.gateway.workers import Worker, WorkerRegistry
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.policies import (
+    DECISION_KEYS,
+    PolicyRegistry,
+    RequestContext,
+    RouteDecision,
+    get_policy,
+)
+from smg_tpu.protocols.events import BlockStored, KvEventBatch
+from smg_tpu.tokenizer import MockTokenizer
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@dataclass
+class FakeWorker:
+    worker_id: str
+    model_id: str = "m"
+    load: int = 0
+    healthy: bool = True
+
+    def is_available(self) -> bool:
+        return self.healthy
+
+
+def fake_workers(n=3):
+    return [FakeWorker(worker_id=f"w{i}") for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# decision ring
+# ---------------------------------------------------------------------------
+
+
+def test_decision_ring_bounded_under_churn():
+    m = Metrics()
+    m.route.ring_size = 8
+    for i in range(100):
+        m.route.record(RouteDecision(policy="round_robin", model_id="m",
+                                     chosen=f"w{i % 4}", outcome="round_robin"))
+    body = m.route.debug_router()
+    assert body["ring_size"] == 8
+    assert body["num_decisions"] == 100
+    ring = body["models"]["m"]
+    assert ring["window"] == 8
+    # newest last, serials strictly increasing, oldest 92 dropped
+    serials = [d["serial"] for d in ring["decisions"]]
+    assert serials == sorted(serials) and serials[-1] == 100 and serials[0] == 93
+
+
+def test_debug_router_limit_and_model_filter_and_schema():
+    m = Metrics()
+    for mid in ("a", "b"):
+        for _ in range(5):
+            m.route.record(RouteDecision(policy="random", model_id=mid,
+                                         chosen="w0", outcome="random"))
+    body = m.route.debug_router(model="a", limit=2)
+    assert set(body["models"]) == {"a"}
+    assert len(body["models"]["a"]["decisions"]) == 2
+    for rec in body["models"]["a"]["decisions"]:
+        assert set(rec) == set(DECISION_KEYS)
+    # unknown model: empty but well-formed
+    assert m.route.debug_router(model="ghost")["models"]["ghost"]["window"] == 0
+
+
+def test_decision_ring_counts_by_policy_and_outcome():
+    m = Metrics()
+    for outcome in ("prefix_hit", "prefix_hit", "below_threshold"):
+        m.route.record(RouteDecision(policy="cache_aware", outcome=outcome))
+    text = generate_latest(m.registry).decode()
+    assert ('smg_route_decisions_total{outcome="prefix_hit",'
+            'policy="cache_aware"} 2.0') in text
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-actual reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_outcomes_error_and_staleness():
+    m = Metrics()
+    route = m.route
+
+    def dec(predicted):
+        d = RouteDecision(policy="cache_aware", model_id="m", chosen="w0",
+                          outcome="prefix_hit", predicted_match_tokens=predicted)
+        route.record(d)
+        return d
+
+    route.reconcile(dec(64), "w0", 64)   # exact
+    route.reconcile(dec(128), "w0", 64)  # over: stale index entries
+    route.reconcile(dec(0), "w0", 32)    # under: missed events
+    body = route.debug_router()
+    stats = body["reconciliation"]["w0"]
+    assert stats["count"] == 3
+    assert (stats["exact"], stats["over"], stats["under"]) == (1, 1, 1)
+    assert stats["mean_abs_error_tokens"] == pytest.approx((0 + 64 + 32) / 3)
+    assert stats["last_predicted"] == 0 and stats["last_actual"] == 32
+    assert body["num_reconciled"] == 3
+    text = generate_latest(m.registry).decode()
+    assert ('smg_route_reconciliations_total{outcome="over",'
+            'worker_id="w0"} 1.0') in text
+    # the decision record itself carries the reconciled truth
+    d = body["models"]["m"]["decisions"][-1]
+    assert d["reconciled"] and d["worker_cached_tokens"] == 32
+    assert d["prediction_error_tokens"] == -32
+
+
+def test_reconcile_is_idempotent_and_skips_no_prediction():
+    route = Metrics().route
+    d = RouteDecision(policy="cache_aware", chosen="w0",
+                      predicted_match_tokens=10)
+    route.reconcile(d, "w0", 10)
+    route.reconcile(d, "w0", 999)  # second chunk must not double-count
+    assert d.worker_cached_tokens == 10
+    assert route.debug_router()["reconciliation"]["w0"]["count"] == 1
+    no_pred = RouteDecision(policy="manual", chosen="w1")
+    route.reconcile(no_pred, "w1", 50)
+    assert not no_pred.reconciled
+    assert "w1" not in route.debug_router()["reconciliation"]
+
+
+def test_staleness_ema_sign_tracks_overstatement():
+    route = Metrics().route
+    for _ in range(10):
+        d = RouteDecision(policy="cache_aware", predicted_match_tokens=100)
+        route.reconcile(d, "w0", 0)  # index claims cache the worker lost
+    stale = route.debug_router()["reconciliation"]["w0"]["staleness"]
+    assert stale > 0.5  # positive EMA = gateway index overstates the worker
+
+
+def test_on_worker_removed_purges_per_worker_state():
+    m = Metrics()
+    route = m.route
+    d = RouteDecision(policy="cache_aware", model_id="m", chosen="w0",
+                      outcome="prefix_hit", predicted_match_tokens=8)
+    route.record(d)
+    route.reconcile(d, "w0", 8)
+    assert "w0" in route.debug_router()["reconciliation"]
+    # the gateway purges through Policy.on_worker_removed (base behavior)
+    p = get_policy("round_robin")
+    p._decision_sink = route
+    p.on_worker_removed("w0")
+    body = route.debug_router()
+    assert "w0" not in body["reconciliation"]
+    text = generate_latest(m.registry).decode()
+    assert 'smg_route_index_staleness{worker_id="w0"}' not in text
+    # ring HISTORY keeps the worker — that is the postmortem record
+    assert body["models"]["m"]["decisions"][-1]["chosen"] == "w0"
+
+
+# ---------------------------------------------------------------------------
+# KvEventMonitor health metrics + fault-injected stale index
+# ---------------------------------------------------------------------------
+
+
+class _EventClient(WorkerClient):
+    """Worker client test double with a controllable kv-event feed."""
+
+    def __init__(self, fail_subscribe=False):
+        self.fail_subscribe = fail_subscribe
+        self.callback = None
+
+    def subscribe_kv_events(self, callback):
+        if self.fail_subscribe:
+            raise RuntimeError("event stream unavailable")
+        self.callback = callback
+        return lambda: None
+
+
+def _event_gateway(page_size=4):
+    registry = WorkerRegistry()
+    policies = PolicyRegistry(
+        default="cache_aware", mode="event", match_threshold=0.25,
+        page_size=page_size, seed=0,
+    )
+    metrics = Metrics()
+    metrics.route.watch(policies)
+    monitor = KvEventMonitor(registry, policies, metrics=metrics)
+    return registry, policies, metrics, monitor
+
+
+def _stored_batch(tokens, page_size=4, seq=1):
+    from smg_tpu.kv_index.positional import chain_hash
+
+    hashes, parent = [], 0
+    for i in range(len(tokens) // page_size):
+        parent = chain_hash(parent, tuple(tokens[i * page_size:(i + 1) * page_size]))
+        hashes.append(parent)
+    return KvEventBatch(sequence_number=seq, events=[
+        BlockStored(block_hashes=hashes, token_ids=list(tokens),
+                    block_size=page_size),
+    ])
+
+
+def test_kv_subscribe_failure_is_metered():
+    registry, _, metrics, monitor = _event_gateway()
+    registry.add(Worker(worker_id="w0", client=_EventClient(fail_subscribe=True),
+                        model_id="m", page_size=4))
+    assert monitor.degraded == {"w0"}
+    text = generate_latest(metrics.registry).decode()
+    assert 'smg_kv_event_subscribe_failures_total{worker_id="w0"} 1.0' in text
+    assert "smg_kv_event_degraded_workers 1.0" in text
+    registry.remove("w0")
+    assert monitor.degraded == set()
+    assert "smg_kv_event_degraded_workers 0.0" in generate_latest(
+        metrics.registry).decode()
+
+
+def test_kv_page_size_mismatch_is_metered():
+    registry, _, metrics, monitor = _event_gateway(page_size=4)
+    # first worker's page size seeds the indexer; once it holds blocks, a
+    # worker that disagrees enters the (previously log-only) degraded mode
+    w0 = _EventClient()
+    registry.add(Worker(worker_id="w0", client=w0, model_id="m", page_size=4))
+    w0.callback(_stored_batch(list(range(8))))
+    registry.add(Worker(worker_id="w1", client=_EventClient(),
+                        model_id="m", page_size=8))
+    assert monitor.degraded == {"w1"}
+    assert "smg_kv_event_degraded_workers 1.0" in generate_latest(
+        metrics.registry).decode()
+
+
+def test_fault_injected_stale_index_reconciliation():
+    """Armed ``gateway.kv_event`` drops event batches: the gateway index goes
+    stale (missing blocks), event-mode matching predicts 0, and reconciling
+    the engine-reported cached_tokens surfaces the drift as ``under`` with a
+    negative staleness EMA — the exact signature of a lost event feed."""
+    registry, policies, metrics, _ = _event_gateway(page_size=4)
+    client = _EventClient()
+    registry.add(Worker(worker_id="w0", client=client, model_id="m",
+                        page_size=4))
+    policy = policies.policy_for("m")
+    tokens = list(range(16))
+
+    FAULTS.arm("gateway.kv_event", mode="always")
+    client.callback(_stored_batch(tokens))  # dropped: index stays empty
+    assert policy.indexer.stats()["blocks"] == 0
+
+    w = FakeWorker(worker_id="w0", model_id="m")
+    chosen, decision = policy.select(
+        [w], RequestContext(model_id="m", token_ids=tokens))
+    assert decision.predicted_match_tokens == 0  # stale index sees nothing
+    # the engine actually had the prefix cached: reconciliation says "under"
+    metrics.route.reconcile(decision, "w0", 16)
+    stats = metrics.route.debug_router()["reconciliation"]["w0"]
+    assert stats["under"] == 1 and stats["staleness"] < 0
+
+    FAULTS.clear()
+    client.callback(_stored_batch(tokens, seq=2))  # feed recovers
+    assert policy.indexer.stats()["per_worker_blocks"]["w0"] == 4
+    chosen, decision = policy.select(
+        [w], RequestContext(model_id="m", token_ids=tokens))
+    assert decision.outcome == "prefix_hit"
+    assert decision.predicted_match_tokens == 16
+    metrics.route.reconcile(decision, "w0", 16)
+    assert metrics.route.debug_router()["reconciliation"]["w0"]["exact"] == 1
+
+
+def test_cache_index_gauges_fold_into_registry():
+    """cache_aware tree/indexer stats surface as gauges on the gateway
+    registry (satellite: CollectorRegistry fold-in)."""
+    registry, policies, metrics, _ = _event_gateway(page_size=4)
+    client = _EventClient()
+    registry.add(Worker(worker_id="w0", client=client, model_id="m",
+                        page_size=4))
+    client.callback(_stored_batch(list(range(16))))
+    text = generate_latest(metrics.registry).decode()
+    assert 'smg_cache_index_blocks{model="m"} 4.0' in text
+    assert ('smg_cache_index_worker_blocks{model="m",worker_id="w0"} 4.0'
+            in text)
+    # approx-mode tree gauges ride the same collector
+    tree_policies = PolicyRegistry(default="cache_aware", mode="approx_token")
+    m2 = Metrics()
+    m2.route.watch(tree_policies)
+    p = tree_policies.policy_for(None)
+    p.select([FakeWorker("w0")],
+             RequestContext(token_ids=list(range(32))))
+    text2 = generate_latest(m2.registry).decode()
+    assert 'smg_cache_tree_elements{model="__default__"} 32.0' in text2
+    assert 'smg_cache_inserted_prefixes{model="__default__"} 1.0' in text2
+
+
+def test_set_policy_replacement_supersedes_cache_policy_registration():
+    """A runtime set_policy replacement must SUPERSEDE the old instance for
+    that model key: keeping both would emit duplicate per-model series from
+    _CacheIndexCollector (failing the whole /metrics scrape) and leak the
+    replaced policy's tree (regression: attach() deduped by identity)."""
+    policies = PolicyRegistry(default="cache_aware", mode="approx_token")
+    m = Metrics()
+    m.route.watch(policies)
+    old = policies.policy_for("modelX")
+    old.select([FakeWorker("w0")], RequestContext(model_id="modelX",
+                                                  token_ids=[1, 2, 3]))
+    policies.set_policy("modelX", "cache_aware", mode="approx_token",
+                        match_threshold=0.2)
+    assert [k for k, _ in m.route.cache_policies()] == ["modelX"]
+    assert m.route.cache_policies()[0][1] is not old
+    text = generate_latest(m.registry).decode()
+    assert text.count('smg_cache_tree_elements{model="modelX"}') == 1
+    # a non-cache replacement drops the key from the collector entirely
+    policies.set_policy("modelX", "round_robin")
+    assert m.route.cache_policies() == []
+    assert 'smg_cache_tree_elements{model="modelX"}' not in (
+        generate_latest(m.registry).decode()
+    )
+
+
+class _LoadsClient(_EventClient):
+    """Event-feed double that also answers the audit's loads() poll."""
+
+    def __init__(self, cached_pages=0):
+        super().__init__()
+        self.cached_pages = cached_pages
+
+    async def get_loads(self):
+        return {"cached_pages": self.cached_pages, "radix_hit_pages": 0}
+
+
+def test_kv_index_audit_scopes_default_to_unscoped_workers():
+    """A worker whose model id maps to its OWN policy instance must not be
+    audited against the ``__default__`` policy's indexer: KvEventMonitor
+    feeds events to ``policy_for(worker.model_id)``, so pairing the default
+    (empty) indexer with another model's worker flags phantom drift in
+    multi-model deployments."""
+    ctx = AppContext(policy="cache_aware",
+                     policy_kwargs={"mode": "event", "page_size": 4, "seed": 0})
+    ctx.policies.policy_for(None)  # materialize the __default__ policy
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(60)
+
+    async def _setup():
+        ctx.registry.add(Worker(worker_id="w-m2",
+                                client=_LoadsClient(cached_pages=500),
+                                model_id="m2", page_size=4))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    tc = run(_setup())
+    try:
+        async def get():
+            resp = await tc.get("/debug/kv_index")
+            assert resp.status == 200
+            return await resp.json()
+
+        body = run(get())
+        assert set(body["gateway"]) == {"__default__", "m2"}
+        rows = {(a["model"], a["worker_id"]): a for a in body["audit"]}
+        # no phantom pairing of m2's worker with the default indexer...
+        assert ("__default__", "w-m2") not in rows
+        # ...while its own model's entry still reports the real divergence
+        m2 = rows[("m2", "w-m2")]
+        assert m2["drift_blocks"] == -500 and m2["flagged"]
+    finally:
+        run(tc.close())
+        loop.call_soon_threadsafe(loop.stop)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: /debug/router + /debug/kv_index over in-proc workers
+# ---------------------------------------------------------------------------
+
+
+def _make_engine() -> Engine:
+    cfg = EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=256, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_batch_size=8, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4, 8),
+        ),
+        dtype="float32",
+        model_id="tiny-test",
+    )
+    return Engine(cfg)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = _make_engine()
+    yield eng
+    eng.stop()
+
+
+POLICY_CONFIGS = [
+    ("round_robin", {}),
+    ("cache_aware", {"mode": "approx_token", "match_threshold": 0.05, "seed": 0}),
+    ("cache_aware", {"mode": "approx_string", "match_threshold": 0.05, "seed": 0}),
+    ("cache_aware", {"mode": "event", "match_threshold": 0.05,
+                     "page_size": 16, "seed": 0}),
+]
+
+
+@pytest.mark.parametrize(
+    "policy,policy_kwargs", POLICY_CONFIGS,
+    ids=[f"{p}-{k.get('mode', 'na')}" for p, k in POLICY_CONFIGS])
+def test_debug_router_and_kv_index_end_to_end(engine, policy, policy_kwargs):
+    """Acceptance: /debug/router returns bounded, schema-stable decision
+    records whose predicted match reconciles against engine-reported
+    cached_tokens for cache_aware (all three modes) and round_robin,
+    end-to-end over an in-proc worker; /debug/kv_index audits the gateway
+    index against worker loads()."""
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    def run(coro, timeout=120):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout)
+
+    ctx = AppContext(policy=policy, policy_kwargs=dict(policy_kwargs))
+    ctx.tokenizers.register("tiny-test", MockTokenizer(), default=True)
+
+    async def _setup():
+        client = InProcWorkerClient(engine)
+        ctx.registry.add(Worker(worker_id="w0", client=client,
+                                model_id="tiny-test", page_size=16))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    tc = run(_setup())
+    try:
+        # distinct long prompt per mode (the module-scoped engine's radix
+        # cache persists across params), sent twice: the second dispatch
+        # reuses the engine-side prefix cache, so cached_tokens > 0 rides
+        # its first chunk and reconciliation has real truth to check
+        mode = policy_kwargs.get("mode", policy)
+        prompt = " ".join(f"w{hash(mode) % 100 + 2}{i} t{i}" for i in range(24))
+
+        async def chat():
+            resp = await tc.post("/v1/chat/completions", json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": prompt}],
+                "max_tokens": 2, "temperature": 0, "ignore_eos": True,
+            })
+            assert resp.status == 200, await resp.text()
+            return await resp.json()
+
+        run(chat())
+        run(chat())
+
+        async def debug(path):
+            resp = await tc.get(path)
+            assert resp.status == 200
+            return await resp.json()
+
+        body = run(debug("/debug/router?limit=16"))
+        assert body["schema_version"] == 1
+        ring = body["models"]["tiny-test"]
+        assert ring["policy"] == policy
+        assert 1 <= ring["window"] <= body["ring_size"]
+        for rec in ring["decisions"]:
+            assert set(rec) == set(DECISION_KEYS)
+            assert rec["policy"] == policy
+            assert rec["chosen"] == "w0"
+            assert rec["candidates"][0]["worker_id"] == "w0"
+            assert rec["decision_us"] > 0
+        reconciled = [d for d in ring["decisions"] if d["reconciled"]]
+        assert reconciled, "first-chunk cached_tokens never reconciled"
+        last = reconciled[-1]
+        assert isinstance(last["worker_cached_tokens"], int)
+        assert last["predicted_match_tokens"] is not None
+        assert (last["prediction_error_tokens"]
+                == last["predicted_match_tokens"] - last["worker_cached_tokens"])
+        assert body["reconciliation"]["w0"]["count"] >= len(reconciled)
+        if policy == "cache_aware":
+            # the repeat request must predict reuse — and the engine page
+            # rounding bounds the honest error at one page
+            assert last["predicted_match_tokens"] > 0
+            assert last["mode"] == policy_kwargs["mode"]
+
+        body = run(debug("/debug/kv_index"))
+        assert body["schema_version"] == 1
+        loads = body["workers"]["w0"]
+        assert "cached_pages" in loads and "radix_hit_pages" in loads
+        if policy == "cache_aware":
+            stats = body["gateway"]["tiny-test"]
+            assert stats["mode"] == policy_kwargs["mode"]
+            assert stats["indexer"]["page_size"] == 16
+            audit = [a for a in body["audit"] if a["worker_id"] == "w0"]
+            assert audit and audit[0]["model"] == "tiny-test"
+            if policy_kwargs["mode"] == "event":
+                assert audit[0]["drift_ratio"] is not None
+        else:
+            assert body["gateway"] == {}  # no cache index to audit
+
+        # bad query params are a 400, not a 500
+        async def bad():
+            return (await tc.get("/debug/router?limit=zap")).status
+        assert run(bad()) == 400
+    finally:
+        run(tc.close())
+        loop.call_soon_threadsafe(loop.stop)
