@@ -112,20 +112,56 @@ def main() -> dict:
     prefill_ms = (time.perf_counter() - t0) * 1e3
     fingerprint.update(bytes(str(r.token_ids), "utf8"))
 
-    # ---- scenario 3: speculative (n-gram) on a repetitive prompt
-    spec_eng = Engine(cfg.replace(scheduler=SchedulerConfig(
-        max_batch_size=4, max_seq_len=256, max_prefill_tokens=64,
-        prefill_token_buckets=(64,), decode_batch_buckets=(4,),
-        speculative=True, spec_max_draft=6,
-    )))
+    # ---- scenario 3: speculative (n-gram) drafter-correctness gate.  The
+    # fingerprint feed is unchanged (rep/24 greedy, the historical stream);
+    # the GATE around it is no longer the vacuous always-accepts readout: a
+    # non-spec twin must produce the byte-identical stream, and a longer
+    # known-repetitive workload must land acceptance in a meaningful band
+    # (drafts really fire AND the fused verify really rejects sometimes).
+    def spec_sched(**kw) -> SchedulerConfig:
+        return SchedulerConfig(
+            max_batch_size=4, max_seq_len=256, max_prefill_tokens=64,
+            prefill_token_buckets=(64,), decode_batch_buckets=(4,), **kw,
+        )
+
+    spec_eng = Engine(cfg.replace(scheduler=spec_sched(
+        speculative=True, spec_max_draft=6)))
     rep = [5, 6, 7, 8] * 8
     r = spec_eng.generate(prompt_ids=rep, sampling=SamplingParams(
         temperature=0.0, max_new_tokens=24, ignore_eos=True))
     fingerprint.update(bytes(str(r.token_ids), "utf8"))
+    nospec_eng = Engine(cfg.replace(scheduler=spec_sched()))
+    r_base = nospec_eng.generate(prompt_ids=rep, sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=24, ignore_eos=True))
+    assert r.token_ids == r_base.token_ids, (
+        "spec gate: temp-0 stream diverged from non-spec "
+        f"({r.token_ids} vs {r_base.token_ids})"
+    )
+    # drafter-correctness workload: repetitive enough to draft heavily, long
+    # and varied enough that acceptance cannot be trivially total
+    gate_jobs = [rep, [9, 9, 9, 9, 9, 9, 9, 9], list(range(40, 70)) + [5, 6, 7, 8] * 4]
+    for p in gate_jobs:
+        rs = spec_eng.generate(prompt_ids=p, sampling=SamplingParams(
+            temperature=0.0, max_new_tokens=32, ignore_eos=True))
+        rb = nospec_eng.generate(prompt_ids=p, sampling=SamplingParams(
+            temperature=0.0, max_new_tokens=32, ignore_eos=True))
+        assert rs.token_ids == rb.token_ids, f"spec gate parity broke on {p[:8]}"
     drafted = spec_eng.scheduler.num_spec_drafted
     accepted = spec_eng.scheduler.num_spec_accepted
+    accept_rate = accepted / drafted if drafted else None
+    assert drafted >= 24, f"spec gate: drafter barely fired ({drafted} tokens)"
+    assert accept_rate is not None and 0.05 <= accept_rate <= 1.0, (
+        f"spec gate: acceptance {accept_rate} outside the meaningful band"
+    )
+    spec_gate = {
+        "parity": "byte-identical",
+        "drafted": drafted,
+        "accepted": accepted,
+        "accept_rate": round(accept_rate, 3),
+    }
     eng.stop()
     spec_eng.stop()
+    nospec_eng.stop()
 
     # ---- scenario 4: host-overlap probe (NOT part of the fingerprint —
     # wall-clock only).  Decode device-calls/s with a synthetic 2ms host
@@ -478,12 +514,91 @@ def main() -> dict:
     except Exception as err:  # the probe must not void the gate
         megastep = {"error": f"{type(err).__name__}: {err}"[:200]}
 
+    # ---- scenario 9: spec probe (NOT part of the fingerprint).  Accepted
+    # -tokens-per-decode-step of the fused draft-verify path vs the plain
+    # K=1 baseline on repetitive workloads — a STEP-COUNT metric (wall-clock
+    # on this box swings ±3x with ambient load; device round trips per token
+    # do not).  Workloads emulate where prompt-lookup drafting pays:
+    # "json_mode" = a tight cyclic token pattern (structured output repeats
+    # its own keys), "code_edit" = a long passage the generation re-emits
+    # (edit-style workloads copy most of their input).  Both engines run
+    # decode_horizon=1 so the number isolates speculation's step-count win
+    # from the megastep's.
+    def spec_round(speculative: bool, prompt: "list[int]", n_new: int) -> dict:
+        e = Engine(EngineConfig(
+            model=probe_model,
+            cache=CacheConfig(page_size=16, num_pages=256, auto_size=False,
+                              dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_batch_size=4, max_seq_len=1024, max_prefill_tokens=128,
+                prefill_token_buckets=(128,), decode_batch_buckets=(4,),
+                decode_horizon=1, overlap_schedule=False,
+                speculative=speculative, spec_max_draft=8,
+            ),
+            dtype="float32", seed=0,
+        ))
+        done: list = []
+        e.submit(prompt, SamplingParams(temperature=0.0, max_new_tokens=n_new,
+                                        ignore_eos=True),
+                 rid="sp", on_output=lambda o: done.append(o.finished))
+        steps = 0
+        decode_steps = 0
+        t0 = time.perf_counter()
+        while not (done and done[-1]):
+            before = e.scheduler.num_decode_tokens
+            e.step()
+            steps += 1
+            if e.scheduler.num_decode_tokens > before:
+                decode_steps += 1
+            if time.perf_counter() - t0 > 180:
+                raise TimeoutError("spec probe stuck")
+        sched = e.scheduler
+        toks = sched.num_decode_tokens
+        out = {
+            "speculative": speculative,
+            "decode_tokens": toks,
+            "decode_steps": decode_steps,
+            "tokens_per_step": round(toks / decode_steps, 3) if decode_steps else None,
+            "drafted": sched.num_spec_drafted,
+            "accepted": sched.num_spec_accepted,
+            "accept_rate": round(
+                sched.num_spec_accepted / sched.num_spec_drafted, 3
+            ) if sched.num_spec_drafted else None,
+        }
+        e.stop()
+        return out
+
+    try:
+        json_prompt = [17, 40, 61, 17, 52, 61, 17, 40, 61, 17, 52, 61] * 4
+        code_prompt = [(7 * j) % 200 + 5 for j in range(48)] * 2
+        spec_probe = {}
+        for name, prompt, n_new in (
+            ("json_mode", json_prompt, 96),
+            ("code_edit", code_prompt, 96),
+        ):
+            on = spec_round(True, prompt, n_new)
+            off = spec_round(False, prompt, n_new)
+            spec_probe[name] = {
+                "spec": on, "baseline": off,
+                "step_speedup": round(
+                    on["tokens_per_step"] / off["tokens_per_step"], 2
+                ) if on["tokens_per_step"] and off["tokens_per_step"] else None,
+            }
+        spec_probe["accepted_tokens_per_step"] = max(
+            v["spec"]["tokens_per_step"] or 0.0
+            for v in spec_probe.values() if isinstance(v, dict) and "spec" in v
+        )
+    except Exception as err:  # the probe must not void the gate
+        spec_probe = {"error": f"{type(err).__name__}: {err}"[:200]}
+
     return {
         "bench": "engine_gate",
         "decode_tok_s": round(decode_tok_s, 1),
         "prefill_ms_64tok": round(prefill_ms, 1),
         "spec_accept_rate": round(accepted / drafted, 3) if drafted else None,
         "spec_drafted": drafted,
+        "spec_gate": spec_gate,
+        "spec_probe": spec_probe,
         "overlap_probe": probe,
         "steady_state_probe": steady,
         "interference_probe": interference,

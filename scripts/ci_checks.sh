@@ -35,7 +35,14 @@
 #                       + /debug/kv_index end-to-end over in-proc workers,
 #                       and per-policy RouteDecision records
 #                       (tests/test_route_observability.py + the decision
-#                       cases in tests/test_policies.py).
+#                       cases in tests/test_policies.py);
+#   8. speculative decoding — fused draft-verify parity: spec-vs-nonspec
+#                       byte-parity at temp 0 across overlap modes, spec
+#                       overlap-on/off parity at temp 0.8, mid-stream
+#                       rejection exactness, quarantine rewind of an
+#                       in-flight spec frame, 0-recompile steady state with
+#                       spec on, tier/flag plumbing
+#                       (tests/test_speculative.py).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -70,5 +77,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_flight_recorder.py -q \
 echo "== routing decision observability =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_route_observability.py \
     tests/test_policies.py -q -m 'not slow' -p no:cacheprovider
+
+echo "== speculative decoding (fused draft-verify) parity =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q \
+    -m 'not slow' -p no:cacheprovider
 
 echo "ci_checks: all green"

@@ -244,11 +244,22 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                         "every step), 'throughput' drains the waiting queue "
                         "per step (legacy; long prompts stall decode)")
     g.add_argument("--speculative", action="store_true",
-                   help="speculative decoding: n-gram prompt-lookup drafts "
-                        "(or a draft model via --draft-model-path); greedy "
-                        "output stays token-identical, sampling uses "
-                        "distribution-preserving rejection sampling")
-    g.add_argument("--spec-max-draft", type=int, default=8, dest="spec_max_draft")
+                   help="speculative decoding: per-request n-gram prompt-"
+                        "lookup drafts (or a draft model via "
+                        "--draft-model-path) verified as one fused batched "
+                        "device block per step; greedy output stays token-"
+                        "identical, sampling uses distribution-preserving "
+                        "rejection sampling")
+    g.add_argument("--speculative-tier", default="auto",
+                   choices=["auto", "ngram", "draft"], dest="speculative_tier",
+                   help="drafting tier: 'auto' = draft model when configured "
+                        "else n-gram lookup; 'ngram' pins the zero-cost "
+                        "prompt-lookup tier; 'draft' requires a draft model")
+    g.add_argument("--spec-max-draft-tokens", "--spec-max-draft", type=int,
+                   default=8, dest="spec_max_draft",
+                   help="max drafted tokens verified per device block "
+                        "(the compiled verify width; per-step depth adapts "
+                        "down under page pressure / cold acceptance)")
     g.add_argument("--draft-model-path", default=None, dest="draft_model_path",
                    help="HF-format dir of a small draft model (replaces "
                         "n-gram proposals)")

@@ -143,6 +143,20 @@ def validate_engine_config(cfg) -> list[ValidationIssue]:
     if cache.dtype not in ("bfloat16", "float32", "float16"):
         issues.append(_err("cache.dtype", f"unsupported KV dtype {cache.dtype!r}"))
 
+    # ---- speculative decoding tiers
+    if getattr(sched, "speculative_tier", "auto") == "draft" and cfg.draft_model is None:
+        issues.append(_err(
+            "scheduler.speculative_tier",
+            "tier 'draft' requires a configured draft model "
+            "(EngineConfig.draft_model / --draft-model-path)",
+        ))
+    if sched.speculative and par.pp > 1:
+        issues.append(_warn(
+            "scheduler.speculative",
+            "the fused verify block does not compose with pipeline "
+            "parallelism; pp engines decode non-speculatively",
+        ))
+
     # ---- dtype coherence
     if cfg.dtype == "bfloat16" and cache.dtype == "float32":
         issues.append(_warn(
@@ -340,6 +354,26 @@ def validate_cli_args(args) -> list[ValidationIssue]:
         ))
     if g("spec_max_draft") is not None and g("spec_max_draft") < 1:
         issues.append(_err("spec_max_draft", "must be >= 1"))
+    if g("speculative_tier") == "draft" and not (
+        g("draft_model_path") or g("draft_model_preset")
+    ):
+        issues.append(_err(
+            "speculative_tier",
+            "tier 'draft' needs --draft-model-path or --draft-model-preset",
+        ))
+    if (
+        g("speculative_tier") not in (None, "auto")
+        and not g("speculative")
+        # an installed draft model enables spec mode by itself (the
+        # scheduler treats draft-is-configured as speculative), so the tier
+        # pin IS live there — e.g. --draft-model-path with tier "ngram"
+        and not (g("draft_model_path") or g("draft_model_preset"))
+    ):
+        issues.append(_warn(
+            "speculative_tier",
+            "--speculative-tier has no effect without --speculative "
+            "(or a configured draft model)",
+        ))
 
     # ---- megastep decode horizon (serve/worker mode)
     if g("decode_horizon") is not None and g("decode_horizon") < 1:

@@ -136,13 +136,23 @@ class SchedulerConfig:
     # path; speculative decoding and grammar-masked batches force a sync
     # boundary (their next device step depends on last step's host results).
     overlap_schedule: bool = True
-    # speculative decoding (prompt-lookup drafting, engine/speculative.py):
-    # greedy requests verify up to spec_max_draft n-gram-proposed tokens in
-    # one forward.  Token-identical to plain greedy decode.
+    # speculative decoding (engine/speculative.py + the fused verify block
+    # in engine/runner.py): eligible lanes draft up to spec_max_draft tokens
+    # host-side and verify them in ONE batched device forward with on-device
+    # acceptance — greedy chains at temperature 0 (token-identical to plain
+    # greedy decode), distribution-preserving rejection sampling above it.
+    # The verify frame pipelines across steps under overlap_schedule, and
+    # no-draft steps fall back to the full megastep horizon (speculation no
+    # longer forces sync + K=1).
     speculative: bool = False
     spec_max_draft: int = 8
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # drafting tier: "auto" uses the draft MODEL when one is configured
+    # (EngineConfig.draft_model) and prompt-lookup n-grams otherwise;
+    # "ngram" pins the zero-cost tier even with a draft model installed;
+    # "draft" requires a configured draft model.
+    speculative_tier: str = "auto"
 
     def __post_init__(self) -> None:
         if self.max_batch_size > max(self.decode_batch_buckets):
@@ -156,6 +166,13 @@ class SchedulerConfig:
             )
         if self.decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        if self.speculative_tier not in ("auto", "ngram", "draft"):
+            raise ValueError(
+                "speculative_tier must be 'auto', 'ngram', or 'draft', "
+                f"got {self.speculative_tier!r}"
+            )
+        if self.spec_max_draft < 1:
+            raise ValueError("spec_max_draft must be >= 1")
         if self.decode_horizon_max and self.decode_horizon_max < self.decode_horizon:
             raise ValueError(
                 "decode_horizon_max must be 0 or >= decode_horizon"

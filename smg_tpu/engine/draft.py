@@ -16,10 +16,11 @@ overwritten by the next ``ensure_context`` — the same overshoot convention
 the target cache already relies on.  Draft state never affects correctness
 (the target verify gates every token); it only affects acceptance rate.
 
-Overlap interaction: an installed draft runner forces the scheduler's
-overlapped pipeline into its synchronous fallback (same as n-gram
-speculation) — ``ensure_context``/``propose`` need last step's committed
-tokens host-side before the next device call can be shaped.
+Overlap interaction: drafting needs last step's committed tokens host-side,
+so the chained lookahead never engages — but the scheduler's pipelined
+speculative schedule (``Scheduler._step_spec``) keeps the fused VERIFY
+frame in flight across steps, so ``ensure_context``/``propose`` host work
+overlaps the target model's device pass.
 """
 
 from __future__ import annotations

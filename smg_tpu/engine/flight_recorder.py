@@ -57,8 +57,10 @@ logger = get_logger("engine.flight_recorder")
 
 #: bump when the dump layout changes; consumers key parsing off this
 #: (v2: megastep decode telemetry — per-step horizon K, device early
-#: exits, and wasted-token count joined the step record)
-SCHEMA_VERSION = 2
+#: exits, and wasted-token count joined the step record; v3: speculative
+#: decoding — per-step drafted/accepted token counts from the fused
+#: verify blocks consumed that step)
+SCHEMA_VERSION = 3
 
 #: stable key set of one step record (schema contract, tested)
 STEP_RECORD_KEYS = frozenset({
@@ -66,6 +68,7 @@ STEP_RECORD_KEYS = frozenset({
     "prefill_tokens", "decode_tokens", "prefill_inflight_tokens",
     "free_pages", "admissions", "finishes", "overlap", "fetch_wait_s",
     "faults", "horizon", "early_exits", "wasted_decode_tokens",
+    "spec_drafted", "spec_accepted",
 })
 
 
@@ -190,6 +193,7 @@ class FlightRecorder:
         fetch_wait_s: float, faults: list | None = None,
         horizon: int = 0, early_exits: int = 0,
         wasted_decode_tokens: int = 0,
+        spec_drafted: int = 0, spec_accepted: int = 0,
     ) -> int:
         """Append one step record; returns the step serial.  Called once per
         scheduler step with values already in hand — no derivation here."""
@@ -226,6 +230,10 @@ class FlightRecorder:
                 "horizon": horizon,
                 "early_exits": early_exits,
                 "wasted_decode_tokens": wasted_decode_tokens,
+                # speculative decoding: draft tokens verified / accepted by
+                # the fused verify blocks consumed this step
+                "spec_drafted": spec_drafted,
+                "spec_accepted": spec_accepted,
             })
             return self.step_serial
 

@@ -157,12 +157,22 @@ class EngineMetrics:
             "Engine request completions by finish reason", ["reason"], registry=r,
         ))
         self.spec_drafted = _track(Counter(
-            "smg_engine_spec_draft_tokens_total",
-            "Speculative tokens proposed (n-gram or draft model)", registry=r,
+            "smg_engine_spec_drafted_tokens_total",
+            "Speculative tokens proposed, by drafting tier (ngram = "
+            "prompt-lookup over the request's own context, draft = small "
+            "draft model)", ["tier"], registry=r,
         ))
         self.spec_accepted = _track(Counter(
             "smg_engine_spec_accepted_tokens_total",
-            "Speculative tokens accepted by the verify pass", registry=r,
+            "Speculative tokens accepted by the fused verify block, by "
+            "drafting tier", ["tier"], registry=r,
+        ))
+        self.spec_accept_len = _track(Histogram(
+            "smg_engine_spec_accepted_length",
+            "Accepted-prefix length per lane per verify block (0 = first "
+            "draft rejected; the distribution the adaptive draft-depth "
+            "controller follows)",
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16), registry=r,
         ))
         self.radix_hit_pages = _track(Counter(
             "smg_engine_radix_hit_pages_total",
@@ -420,8 +430,6 @@ class EngineMetrics:
         )
         self.radix_cached_pages.set(cached_pages)
         for key, counter in (
-            ("spec_drafted", self.spec_drafted),
-            ("spec_accepted", self.spec_accepted),
             ("preemptions", self.preemptions),
             ("radix_hit_pages", self.radix_hit_pages),
             ("radix_miss_pages", self.radix_miss_pages),
@@ -436,6 +444,15 @@ class EngineMetrics:
 
     def on_finish(self, reason: str) -> None:
         self.requests_finished.labels(reason=reason or "unknown").inc()
+
+    def observe_spec(self, tier: str, drafted: int, accepted: int) -> None:
+        """Record one lane's draft-verify outcome (called per eligible lane
+        per consumed verify block): tier-labeled drafted/accepted token
+        totals plus the acceptance-length sample the depth controller's EMA
+        mirrors."""
+        self.spec_drafted.labels(tier=tier).inc(drafted)
+        self.spec_accepted.labels(tier=tier).inc(accepted)
+        self.spec_accept_len.observe(accepted)
 
     def observe_overlap(
         self, *, outcome: str, fetch_wait_s: float, host_s: float

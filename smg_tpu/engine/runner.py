@@ -266,17 +266,12 @@ class ModelRunner:
         pipeline's async launch.  TPU/GPU clients dispatch donated calls
         asynchronously, and there donation is non-negotiable (the cache is
         most of HBM).  Scoped to configurations where the overlapped
-        schedule is actually ACTIVE (mirrors Scheduler.step's condition:
-        overlap on, no speculative/draft decoding forcing the sync
-        fallback): a synchronous CPU run gains nothing from async dispatch,
-        so it keeps donation (and the in-place cache update) rather than
-        paying a full cache copy per decode call."""
-        sched = self.config.scheduler
-        if (
-            not sched.overlap_schedule
-            or sched.speculative
-            or self.config.draft_model is not None
-        ):
+        schedule is actually ACTIVE (overlap on — including speculative
+        decoding, whose batched verify frames stay in flight across steps
+        since the fused spec path landed): a synchronous CPU run gains
+        nothing from async dispatch, so it keeps donation (and the in-place
+        cache update) rather than paying a full cache copy per call."""
+        if not self.config.scheduler.overlap_schedule:
             return False
         try:
             return self.local_devices()[0].platform == "cpu"
@@ -1296,158 +1291,172 @@ class ModelRunner:
                                      use_mrope=rope_pos is not None)
         self.k_cache, self.v_cache = fn(*(base_args + tail_args))
 
-    def _verify_fn(self, T: int, mp: int, use_mrope: bool = False):
-        """Speculative verify: one prefill-shaped forward returning the
-        greedy argmax at EVERY chunk position (engine/speculative.py) —
-        K draft tokens scored in one MXU pass instead of K decode steps."""
-        impl = self._prefill_impl_for(mp)
-        k = ("verify", T, mp, impl, use_mrope)
+    def _decode_spec_fn(self, B: int, mp: int, W: int, use_mrope: bool = False):
+        """The fused speculative VERIFY megastep: score a W-token draft block
+        for every lane in ONE forward, accept on device, and scatter only the
+        accepted columns' KV into the cache (rejected columns go to the
+        garbage page).  The spec analogue of ``_decode_multi_fn``: where the
+        decode megastep runs K serial in-loop forwards for K tokens, this
+        program yields up to W tokens per lane for ONE weight pass — the
+        classic draft-verify win on a bandwidth-bound decode — while sharing
+        the megastep's conventions: the launch consumes a sampling-key
+        counter fold (column-0's ``fold_in(base, mark+1)``, exactly the key a
+        K=1 launch would fold at that global step; ``InFlightFrame.folds``
+        rewinds it when the frame is discarded), positions past the page
+        table scatter to the garbage page, and padded batch rows are inert.
+
+        Acceptance per lane (per-lane ``draft_n`` rides a device scalar, so
+        variable drafting never retraces):
+
+        - temperature == 0: greedy chain — accept drafted column c+1 while it
+          equals the argmax after column c; the first mismatch's argmax is
+          the correction token.  Token-identical to plain greedy decode.
+        - temperature > 0: ``sampling.spec_accept_sample`` vmapped over lanes
+          (per-lane split keys) — distribution-preserving rejection sampling
+          specialized to the deterministic draft.
+
+        Returns (emitted [B, W] int32, n_emit [B] int32, caches): lane b's
+        tokens are ``emitted[b, :n_emit[b]]`` (accepted drafts + the
+        bonus/correction sample); columns past ``n_emit`` are unset."""
+        k = ("decode_spec", B, mp, W, use_mrope)
         if k in self._compiled:
             return self._compiled[k]
         cfg = self.model_cfg
         module = self.module
-        pp_mesh = self.mesh if self.use_pp else None
+        ps = self.spec.page_size
+        KD = cfg.num_kv_heads * cfg.head_dim
+        L = cfg.num_layers
 
-        def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc,
-                 page_table, *extra):
-            rope_pos = extra[0] if use_mrope else None
-            logits, kc, vc = module.forward_prefill(
-                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc,
-                page_table, attn_impl=impl, rope_pos=rope_pos,
-                pp_mesh=pp_mesh,
-                all_logits=True,
-            )
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
-
-        if self.mesh is not None:
-            r = self._replicated
-            in_sh = (self.param_shardings, r, r, r, r,
-                     self.kv_sharding, self.kv_sharding, r)
-            in_sh = in_sh + ((r,) if use_mrope else ())
-            fn = jax.jit(step, in_shardings=in_sh,
-                         out_shardings=(r, self.kv_sharding, self.kv_sharding),
-                         donate_argnums=(5, 6))
-        else:
-            fn = jax.jit(step, donate_argnums=(5, 6))
-        self._compiled[k] = fn
-        return fn
-
-    def verify(
-        self,
-        token_ids: "list[int]",
-        prefix_len: int,
-        page_table: np.ndarray,
-        rope_pos: "np.ndarray | None" = None,
-    ) -> np.ndarray:
-        """Greedy argmax after each of ``token_ids`` fed at positions
-        ``prefix_len..`` (KV for all fed tokens is written — overshoot past
-        the accepted seq_len is garbage-by-convention)."""
-        t = len(token_ids)
-        T = self.config.scheduler.prefill_bucket(t)
-        ps = self.config.cache.page_size
-        mp = len(page_table)
-        if prefix_len + t > mp * ps:
-            raise ValueError("verify chunk overruns page table")
-        tokens = np.zeros(T, np.int32)
-        tokens[:t] = token_ids
-        fn = self._verify_fn(T, mp, use_mrope=rope_pos is not None)
-        args = [
-            self.params, self.inv_freq, jnp.asarray(tokens),
-            jnp.int32(prefix_len), jnp.int32(t),
-            self.k_cache, self.v_cache,
-            jnp.asarray(page_table, jnp.int32),
-        ]
-        if rope_pos is not None:
-            rp = np.zeros((3, T), np.int32)
-            rp[:, :t] = rope_pos
-            args.append(jnp.asarray(rp))
-        arg, self.k_cache, self.v_cache = fn(*args)
-        return jax.device_get(arg)[:t]  # intended blocking fetch
-
-    def _verify_sample_fn(self, T: int, mp: int, use_mrope: bool = False):
-        """Speculative verify for temperature > 0: the prefill-shaped
-        forward feeds [y0, drafts...] and the acceptance runs ON DEVICE via
-        rejection sampling (``engine/sampling.py::spec_accept_sample``) —
-        distribution-preserving, no full-vocab distributions shipped to
-        host."""
-        impl = self._prefill_impl_for(mp)
-        k = ("verify_sample", T, mp, impl, use_mrope)
-        if k in self._compiled:
-            return self._compiled[k]
-        cfg = self.model_cfg
-        module = self.module
-        pp_mesh = self.mesh if self.use_pp else None
-
-        def step(params, inv_freq, tokens, prefix_len, t_real, kc, vc,
-                 page_table, key, temp, topk, topp, minp, proposals, k_real,
+        def spec(params, inv_freq, tokens, draft_n, entry_pos, kc, vc,
+                 page_tables, base_key, step0, temps, topks, topps, minps,
                  *extra):
             from smg_tpu.engine.sampling import spec_accept_sample
 
-            rope_pos = extra[0] if use_mrope else None
-            logits, kc, vc = module.forward_prefill(
-                params, cfg, inv_freq, tokens, prefix_len, t_real, kc, vc,
-                page_table, attn_impl=impl, rope_pos=rope_pos,
-                pp_mesh=pp_mesh,
-                all_logits=True,
-            )
-            final, n_acc = spec_accept_sample(
-                logits, proposals, k_real, key, temp, topk, topp, minp
-            )
-            return final, n_acc, kc, vc
+            rope_delta = extra[0] if use_mrope else None
+            logits, bk, bv = module.forward_verify_block(
+                params, cfg, inv_freq, tokens, entry_pos, kc, vc, page_tables,
+                rope_delta=rope_delta,
+            )  # [B, W, V], [L, B, W, KD] x2
+            props = tokens[:, 1:]  # [B, W-1] drafted columns
+            greedy = temps <= 0.0
+            # greedy chain: accept while draft matches the running argmax
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
+            cols = jnp.arange(W - 1)
+            match = (props == g[:, :-1]) & (cols[None, :] < draft_n[:, None])
+            n_acc_g = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            final_g = jnp.take_along_axis(g, n_acc_g[:, None], axis=1)[:, 0]
+            # sampled lanes: rejection sampling, one split key per lane off
+            # the launch fold (column-0's megastep key)
+            kj = jax.random.fold_in(base_key, step0 + jnp.uint32(1))
+            keys = jax.random.split(kj, B)
+            safe_t = jnp.where(greedy, 1.0, temps)  # discarded for greedy rows
 
+            def one(row_logits, row_props, k_real, key, t, tk, tp, m):
+                return spec_accept_sample(row_logits, row_props, k_real, key,
+                                          t, tk, tp, m)
+
+            final_s, n_acc_s = jax.vmap(one)(
+                logits, props, draft_n, keys, safe_t, topks, topps, minps
+            )
+            n_acc = jnp.where(greedy, n_acc_g, n_acc_s).astype(jnp.int32)
+            final = jnp.where(greedy, final_g, final_s).astype(jnp.int32)
+            # emitted row: accepted drafts then the bonus/correction token
+            c = jnp.arange(W)[None, :]
+            props_pad = jnp.concatenate(
+                [props, jnp.zeros((B, 1), jnp.int32)], axis=1
+            )
+            emitted = jnp.where(c < n_acc[:, None], props_pad, 0)
+            emitted = jnp.where(c == n_acc[:, None], final[:, None], emitted)
+            n_emit = n_acc + 1
+            # per-token logprobs, OpenAI semantics (log softmax of the RAW
+            # logits at the emitted token — same rule as sampling.py):
+            # emitted column c was chosen from column c's distribution
+            all_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            lps = jnp.take_along_axis(
+                all_lp, emitted[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            # KV discipline: column c's K/V (input token at entry+c) lands in
+            # its real slot only when that token is COMMITTED — c=0 is the
+            # already-committed y0, c>=1 iff the draft was accepted.  Every
+            # rejected column and every out-of-table position masks to the
+            # garbage page, so a bad draft can never poison a real slot.
+            total = mp * ps
+            pos = entry_pos[:, None] + jnp.arange(W)[None, :]  # [B, W]
+            valid = (c <= n_acc[:, None]) & (pos < total)
+            pos_c = jnp.minimum(pos, total - 1)
+            page = jnp.take_along_axis(page_tables, pos_c // ps, axis=1)
+            dest = jnp.where(valid, page * ps + pos_c % ps, 0).reshape(-1)
+            kvals = bk.reshape(L, B * W, KD)
+            vvals = bv.reshape(L, B * W, KD)
+            P = kc.shape[1]
+            kc = kc.reshape(L, P * ps, KD).at[:, dest].set(
+                kvals.astype(kc.dtype)
+            ).reshape(kc.shape)
+            vc = vc.reshape(L, P * ps, KD).at[:, dest].set(
+                vvals.astype(vc.dtype)
+            ).reshape(vc.shape)
+            return emitted, n_emit, lps, kc, vc
+
+        donate = () if self._kv_donation_blocks_dispatch() else (5, 6)
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
-                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r, r, r)
+                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r, r)
             in_sh = in_sh + ((r,) if use_mrope else ())
-            fn = jax.jit(step, in_shardings=in_sh,
-                         out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
-                         donate_argnums=(5, 6))
+            fn = jax.jit(spec, in_shardings=in_sh,
+                         out_shardings=(r, r, r, self.kv_sharding,
+                                        self.kv_sharding),
+                         donate_argnums=donate)
         else:
-            fn = jax.jit(step, donate_argnums=(5, 6))
+            fn = jax.jit(spec, donate_argnums=donate)
         self._compiled[k] = fn
         return fn
 
-    def verify_sample(
+    def decode_spec_async(
         self,
-        token_ids: "list[int]",  # [y0, drafts...]
-        prefix_len: int,
-        page_table: np.ndarray,
-        temperature: float,
-        top_k: int,
-        top_p: float,
-        min_p: float,
-        rope_pos: "np.ndarray | None" = None,
-    ) -> tuple[int, int]:
-        """Returns (final_token, n_accepted); the caller commits
-        ``token_ids[1:1+n_accepted] + [final_token]``."""
-        t = len(token_ids)
-        T = self.config.scheduler.prefill_bucket(t)
-        ps = self.config.cache.page_size
-        mp = len(page_table)
-        if prefix_len + t > mp * ps:
-            raise ValueError("verify chunk overruns page table")
-        tokens = np.zeros(T, np.int32)
-        tokens[:t] = token_ids
-        proposals = np.zeros(max(T - 1, 1), np.int32)
-        proposals[: t - 1] = token_ids[1:]
-        fn = self._verify_sample_fn(T, mp, use_mrope=rope_pos is not None)
+        tokens,  # [B, W] int32: [last_committed, drafts..., pad]
+        draft_n,  # [B] int32 valid drafts per lane (0 = plain 1-token decode)
+        positions,  # [B] int32 entry positions (= seq_len per lane)
+        page_tables,  # [B, mp] int32
+        temps,
+        topks,
+        topps,
+        minps,
+        rope_delta=None,  # [B] M-RoPE decode offsets
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """Dispatch one fused draft-verify block and return UNMATERIALIZED
+        (emitted [B, W], n_emit [B], logprobs [B, W]).  Consumes exactly ONE
+        sampling-key
+        counter fold (the caller's frame records ``folds=1`` so a discarded
+        frame rewinds it); per-lane draft counts ride device scalars, so the
+        trace is keyed only on (B, mp, W).  All uploads are explicit
+        ``device_put``s — the steady-state transfer guard stays clean with
+        speculation on."""
+        B, mp = page_tables.shape
+        W = tokens.shape[1]
+        use_mrope = rope_delta is not None
+        fn = self._decode_spec_fn(B, mp, W, use_mrope)
+        mark = self._consume_folds(1)
         args = [
-            self.params, self.inv_freq, jnp.asarray(tokens),
-            jnp.int32(prefix_len), jnp.int32(t),
-            self.k_cache, self.v_cache,
-            jnp.asarray(page_table, jnp.int32),
-            self._next_key(),
-            jnp.float32(temperature), jnp.int32(top_k),
-            jnp.float32(top_p), jnp.float32(min_p),
-            jnp.asarray(proposals), jnp.int32(t - 1),
+            self.params,
+            self.inv_freq,
+            _dev(tokens, jnp.int32),
+            _dev(draft_n, jnp.int32),
+            _dev(positions, jnp.int32),
+            self.k_cache,
+            self.v_cache,
+            _dev(page_tables, jnp.int32),
+            self._rng_key,
+            jax.device_put(np.uint32(mark)),
+            _dev(temps, jnp.float32),
+            _dev(topks, jnp.int32),
+            _dev(topps, jnp.float32),
+            _dev(minps, jnp.float32),
         ]
-        if rope_pos is not None:
-            rp = np.zeros((3, T), np.int32)
-            rp[:, :t] = rope_pos
-            args.append(jnp.asarray(rp))
-        final, n_acc, self.k_cache, self.v_cache = fn(*args)
-        return int(final), int(n_acc)
+        if use_mrope:
+            args.append(_dev(rope_delta, jnp.int32))
+        emitted, n_emit, lps, self.k_cache, self.v_cache = fn(*args)
+        return emitted, n_emit, lps
 
     def decode(
         self,

@@ -32,9 +32,15 @@ decisions with a fixed key-fold ordering rule — prefill folds before the
 step's decode fold — so the lookahead SURVIVES admissions that stay
 fold-free (resumable non-final chunks, requests parked ``PREFILLING``,
 waiting-over-budget, back-pressure) and is only suppressed for the one
-step in which a prefill actually samples.  Speculative decoding and grammar-masked
-batches force a sync boundary (their next device call depends on last
-step's host results).  ``DecodeState`` keeps steady-state decode inputs
+step in which a prefill actually samples.  Grammar-masked batches force a
+sync boundary (their next device call depends on last step's host
+results).  Speculative decoding runs its own pipelined variant
+(``_step_spec``): eligible lanes draft host-side (n-gram index or draft
+model) and verify as ONE batched fused device block
+(``runner.decode_spec_async``) whose frame stays in flight across steps —
+the host-side drafting, detokenize, and stream callbacks overlap the
+device's verify pass exactly as the lookahead overlaps decode.
+``DecodeState`` keeps steady-state decode inputs
 (sampling params, penalty scalars, LoRA indices, page tables)
 device-resident, refreshed only on batch-composition or page-table change.
 """
@@ -103,6 +109,16 @@ class InFlightFrame:
     lookahead: bool = False
     folds: int = 1  # sampling-key counter values consumed by the launch
     steps_run: "object" = None  # jax.Array scalar: columns the device loop ran
+    # speculative verify frames (``_launch_spec_frame``): ``toks`` holds the
+    # emitted rows [B, W] (accepted drafts + bonus/correction), ``n_emit``
+    # the per-lane emit counts, and the host-side draft metadata feeds the
+    # acceptance telemetry at consume.  ``horizon`` is the compiled block
+    # width W and ``folds`` is 1 (one launch fold; ``_discard_frame``'s
+    # rewind machinery applies unchanged).
+    spec: bool = False
+    n_emit: "object" = None  # jax.Array [B] (spec frames only)
+    draft_ns: "list | None" = None  # per-lane drafted-token counts
+    tiers: "list | None" = None  # per-lane drafting tier ("ngram"/"draft")
 
 
 class Scheduler:
@@ -171,6 +187,12 @@ class Scheduler:
         self._cols_since_finish = 0
         # step-scoped megastep telemetry for the flight-recorder ring
         self._step_horizon = 0
+        # step-scoped speculative-decoding telemetry (flight-recorder ring
+        # spec fields) + the acceptance-length EMA the adaptive depth
+        # controller reads (_pick_spec_depth)
+        self._step_spec_drafted = 0
+        self._step_spec_accepted = 0
+        self._spec_accept_ema = 0.0
         # failure isolation (poison-step quarantine / deadlines / drain)
         self.num_quarantined = 0
         self.num_step_failures = 0
@@ -406,6 +428,8 @@ class Scheduler:
         self._step_outcome = None
         self._step_fetch_s = 0.0
         self._step_horizon = 0
+        self._step_spec_drafted = 0
+        self._step_spec_accepted = 0
         pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
         we0, ee0 = self.num_wasted_decode_tokens, self.num_megastep_early_exits
         t0 = time.perf_counter()
@@ -445,6 +469,8 @@ class Scheduler:
                     horizon=self._step_horizon,
                     early_exits=self.num_megastep_early_exits - ee0,
                     wasted_decode_tokens=self.num_wasted_decode_tokens - we0,
+                    spec_drafted=self._step_spec_drafted,
+                    spec_accepted=self._step_spec_accepted,
                 )
                 self.flush_pending_dumps()
         return outputs
@@ -464,17 +490,20 @@ class Scheduler:
         self._expire_deadlines(outputs)
         pf0, dc0 = self.num_prefill_tokens, self.num_decode_tokens
         t0 = time.perf_counter() if m else 0.0
-        # the speculative paths (n-gram + draft model) force a sync boundary:
-        # their NEXT device call (propose/verify shapes, acceptance) depends
-        # on last step's host-side results, so there is nothing to overlap
-        overlap = (
-            self.sched.overlap_schedule
-            and not self.sched.speculative
-            and self.draft is None
-        )
+        # speculative mode runs its own pipelined schedule: drafting needs
+        # last step's accepted tokens host-side, so the chained LOOKAHEAD is
+        # impossible — but the batched verify frame itself stays in flight
+        # across steps (launched at the end of step N, consumed at the top
+        # of step N+1), overlapping drafting/detokenize/callbacks with the
+        # device's verify pass
+        spec_mode = self.sched.speculative or self.draft is not None
+        overlap = self.sched.overlap_schedule and not spec_mode
         if overlap:
             admit_s, fetch_s, outcome = self._step_overlap(outputs)
             # stash for the step's flight-recorder ring record
+            self._step_outcome, self._step_fetch_s = outcome, fetch_s
+        elif spec_mode and self.sched.overlap_schedule:
+            admit_s, fetch_s, outcome = self._step_spec(outputs)
             self._step_outcome, self._step_fetch_s = outcome, fetch_s
         else:
             self.drop_inflight()  # mode flip mid-run: never strand a frame
@@ -499,8 +528,6 @@ class Scheduler:
                 total_pages=self.runner.spec.num_pages,
                 cached_pages=self.radix.num_cached_pages if self.radix else 0,
                 cumulative={
-                    "spec_drafted": self.num_spec_drafted,
-                    "spec_accepted": self.num_spec_accepted,
                     "preemptions": self.num_preemptions,
                     "radix_hit_pages": self.num_radix_hit_pages,
                     "radix_miss_pages": self.num_radix_miss_pages,
@@ -834,6 +861,10 @@ class Scheduler:
         admission either folds a key there (which suppresses the next
         lookahead) or parks the request ``PREFILLING`` outside the lane set
         — either way the frame in flight still matches the sync schedule."""
+        if frame.spec:
+            # a spec frame reaching the non-spec pipeline is a mode mix-up
+            # (runtime config flip): never consume it here
+            return True
         active = self._decode_active()
         if len(active) != len(frame.lanes):
             return True
@@ -1646,14 +1677,21 @@ class Scheduler:
         """Synchronous decode: plan + launch + immediate consume (the overlap
         pipeline calls the same launch/consume halves with a frame between).
         Runs EVERY step — a request mid-resumable-prefill holds its slot but
-        never blocks the running lanes from decoding."""
+        never blocks the running lanes from decoding.  Speculative mode runs
+        the same phase ordering as the pipelined ``_step_spec`` (rest
+        megastep, then the batched verify block) with the frame consumed
+        in-step — which is exactly what keeps overlap-on and overlap-off
+        spec streams byte-identical."""
         active = self._decode_active()
         if not active:
             return
-        if self.sched.speculative:
-            active = self._decode_speculative(active, outputs)
-            if not active:
-                return
+        if self.sched.speculative or self.draft is not None:
+            self._spec_phase(outputs, pipelined=False)
+            return
+        self._decode_batch(active, outputs)
+
+    def _decode_batch(self, active: list, outputs: list[StepOutput]) -> None:
+        """Launch one megastep for ``active`` and consume it in-step."""
         frame = self._launch_frame(active)
         if frame is not None:
             try:
@@ -1784,8 +1822,10 @@ class Scheduler:
           design: any lane with stop strings forces K=1 (the "near-window"
           refinement would need per-token detokenization to bound).
 
-        (Speculative decoding never reaches here — it forces the sync
-        scheduler path upstream.)
+        (Under speculative mode this governs the NO-DRAFT steps and the
+        rest batch: when nothing proposes, the whole batch rides the full
+        horizon here — speculation itself budgets its depth in
+        ``_pick_spec_depth``, the other half of the same budget.)
 
         Pending admission work — a non-empty waiting queue or a resumable
         ``PREFILLING`` slot — ALSO forces K=1, for byte-parity rather than
@@ -1951,29 +1991,98 @@ class Scheduler:
             folds=horizon, steps_run=steps_run,
         )
 
-    def _decode_speculative(self, active, outputs: list[StepOutput]):
-        """Run spec-eligible slots through draft+verify; returns the slots
-        the normal batched decode should still handle.
+    # ---- speculative decoding (two-tier drafting + fused batched verify) ----
+    #
+    # The production spec path: eligible lanes draft host-side — the default
+    # zero-cost tier matches the request's own recent tokens against its
+    # per-lane incremental n-gram index ("prompt lookup decoding"); an
+    # optional small draft MODEL (engine/draft.py) replaces it when
+    # configured — and ALL eligible lanes verify in ONE fused device block
+    # (``runner.decode_spec_async``): K drafted positions scored in a single
+    # forward, acceptance on device (greedy chain at temp 0, rejection
+    # sampling at temp > 0), rejected columns' KV masked to the garbage
+    # page.  With overlap on, the verify frame stays IN FLIGHT across steps
+    # (launched at the end of step N, consumed at the top of step N+1), so
+    # drafting/detokenize/callbacks hide behind the device pass; the frame
+    # rides the InFlightFrame staleness/rewind machinery, so stop-string
+    # rollback, abort, deadline expiry, and quarantine discard it and rewind
+    # its sampling-key fold exactly like a discarded lookahead.  Steps where
+    # nothing drafts run the plain megastep at the controller's FULL horizon
+    # — speculation no longer forces sync + K=1.
+
+    def _partition_spec(self, active: list) -> tuple[list, list]:
+        """Split decode-eligible lanes into (spec-eligible, rest).
 
         Eligible = unconstrained, penalty-free, no logprobs, no LoRA (the
-        verify pass scores BASE-model distributions only); M-RoPE requests
-        verify with text rope ids + delta.  Proposals come from the draft
-        MODEL when one is configured (engine/draft.py), else prompt-lookup
-        n-grams.  Acceptance: greedy chains for temperature == 0 (token
-        -identical to plain greedy decode); DISTRIBUTION-PRESERVING
-        rejection sampling on device for temperature > 0
-        (``sampling.spec_accept_sample`` — r5, VERDICT #4).  Each verify
-        feeds [last_token, drafts...] as one prefill-shaped forward and
-        yields >= 1 token.  Caveats the adaptive back-off (spec_cold)
-        exists for: with decode_horizon > 1 the plain path yields horizon
-        tokens per call, so persistently-missing drafts WOULD lose — three
-        straight zero-acceptance verifies push the request back to the
-        batched path."""
-        from smg_tpu.engine.speculative import (
-            SpecConfig,
-            accept_greedy,
-            propose_ngram,
-        )
+        verify scores BASE-model distributions only), and no stop STRINGS
+        (engine-layer matches would roll back mid-block emissions — stop
+        string lanes keep the K=1 megastep path, same rule as the horizon
+        matrix).  M-RoPE lanes are eligible (text rope ids + delta).
+        Membership is static per request, which keeps the in-flight spec
+        frame's staleness check meaningful.  pp engines fall back entirely
+        (the fused block doesn't compose with the layer-sharded scan)."""
+        if self.runner.use_pp or not hasattr(
+            self.runner.module, "forward_verify_block"
+        ):
+            return [], active
+        eligible, rest = [], []
+        for slot, req in active:
+            sp = req.sampling
+            ok = (
+                req.token_filter is None
+                and not sp.has_penalties
+                and not sp.logprobs
+                and not req.lora_idx
+                and not sp.stop
+                and bool(req.output_ids)
+            )
+            (eligible if ok else rest).append((slot, req))
+        return eligible, rest
+
+    def _spec_tier(self) -> str:
+        """Resolve the drafting tier: the draft model serves when installed
+        (unless the config pins "ngram"); prompt-lookup n-grams otherwise."""
+        tier = getattr(self.sched, "speculative_tier", "auto")
+        if self.draft is not None and tier in ("auto", "draft"):
+            return "draft"
+        return "ngram"
+
+    def _pick_spec_depth(self, eligible: list) -> int:
+        """Budget this launch's draft depth — the speculation half of the
+        horizon controller's budget (``_pick_horizon`` still owns the
+        multi-step-decode half for no-draft steps and the rest batch):
+
+        - cap at ``spec_max_draft`` (the compiled block width);
+        - adaptive mode tracks the acceptance-length EMA and drafts one past
+          it (deep drafts on a cold context waste verify columns);
+        - page headroom clamps exactly like the megastep's K clamp: growing
+          every eligible lane depth+1 tokens must fit the free pool, never
+          force an eviction cascade for speculation."""
+        sched = self.sched
+        d = max(1, sched.spec_max_draft)
+        if sched.adaptive_horizon and self._spec_accept_ema > 0.0:
+            d = min(d, int(self._spec_accept_ema) + 2)
+        ps = self.ps
+        while d > 1:
+            need = 0
+            for _, r in eligible:
+                limit = min(r.seq_len + d + 1, sched.max_seq_len)
+                have = len(r.shared_pages) + len(r.owned_pages)
+                need += max(0, math.ceil(limit / ps) - have)
+            if need <= self.pool.free_count:
+                break
+            d //= 2
+        return d
+
+    def _collect_drafts(self, eligible: list) -> dict:
+        """Per-lane draft proposals: {slot: (proposals, tier)}.  The draft
+        -model tier ensures KV capacity BEFORE proposing (draft KV writes
+        ride the same page tables); the n-gram tier is pure host lookup.
+        Lanes in acceptance back-off (``spec_cold``) or out of room propose
+        nothing — ``_spec_phase`` routes them to the rest megastep at the
+        controller's full horizon (the back-off's whole point: a lane whose
+        drafts keep missing must not lose the multi-token decode path)."""
+        from smg_tpu.engine.speculative import SpecConfig, propose_ngram
 
         cfg = SpecConfig(
             enabled=True,
@@ -1981,29 +2090,18 @@ class Scheduler:
             ngram_max=self.sched.spec_ngram_max,
             ngram_min=self.sched.spec_ngram_min,
         )
-        rest = []
-        for slot, req in active:
-            sp = req.sampling
-            eligible = (
-                req.token_filter is None
-                and not sp.has_penalties
-                and not sp.logprobs
-                and not req.lora_idx  # verify runs the BASE weights only
-                and req.output_ids
-                and req.spec_cold < 3  # acceptance back-off
-            )
-            if not eligible:
-                rest.append((slot, req))
-                continue
+        depth = self._pick_spec_depth(eligible)
+        tier = self._spec_tier()
+        out: dict = {}
+        for slot, req in eligible:
             if self.slots[slot] is not req:
-                continue  # a prior iteration's preemption evicted this one
+                continue  # evicted as a peer's preemption victim
             room = min(self.sched.max_seq_len, self.mp * self.ps)
-            k_room = max(0, room - req.seq_len - 1)
-            if self.draft is not None:
-                k = min(cfg.max_draft, k_room)
-                if k <= 0:
-                    rest.append((slot, req))
-                    continue
+            k = min(depth, max(0, room - req.seq_len - 1))
+            if k <= 0 or req.spec_cold >= 3:
+                out[slot] = ([], None)
+                continue
+            if tier == "draft":
                 # capacity FIRST: the draft writes KV through the same page
                 # table, so pages must exist before ensure_context/propose
                 if not self._ensure_seq_capacity(req, k + 1):
@@ -2021,62 +2119,263 @@ class Scheduler:
                     index=req.spec_index
                     if req.spec_index is not None
                     else self._new_spec_index(req, cfg),
-                )[:k_room]
-                if not proposals:
-                    rest.append((slot, req))
-                    continue
-                if not self._ensure_seq_capacity(req, len(proposals) + 1):
-                    continue  # preempted
-                if self.slots[slot] is not req:
-                    continue
-            chunk = [req.output_ids[-1]] + proposals
-            # trim the page table to live pages (same 32x-gather argument as
-            # the batched decode path above)
-            mp_b = self._mp_bucket(math.ceil(
-                min(req.seq_len + len(chunk), self.sched.max_seq_len) / self.ps
-            ))
-            seq_before = req.seq_len
-            rope_pos = self._mrope_chunk(req, req.seq_len, len(chunk))
-            if sp.temperature == 0.0:
-                arg = self.runner.verify(
-                    chunk, prefix_len=req.seq_len,
-                    page_table=self.page_tables[slot][:mp_b],
-                    # M-RoPE: generated positions are text (3 equal axes +
-                    # delta), exactly what _mrope_chunk emits past the prompt
-                    rope_pos=rope_pos,
+                )[:k]
+            out[slot] = (proposals, tier if proposals else None)
+        return out
+
+    def _spec_phase(self, outputs: list[StepOutput], pipelined: bool) -> None:
+        """The decode phase under speculative mode, SAME ordering in both
+        schedules (this is what keeps overlap-on/off spec streams
+        byte-identical): draft (capacity ensures may preempt), rest-lane
+        megastep, then the batched verify launch — left in flight when
+        ``pipelined``, consumed in-step otherwise.  When no lane drafted
+        anything, the whole batch takes the plain megastep at the
+        controller's full horizon instead."""
+        active = self._decode_active()
+        if not active:
+            return
+        eligible, rest = self._partition_spec(active)
+        drafts = self._collect_drafts(eligible) if eligible else {}
+        # only lanes that actually PROPOSED ride the verify block; everyone
+        # else — ineligible lanes, acceptance back-off (spec_cold), nothing
+        # to propose, out of room — takes the rest megastep at the
+        # controller's FULL horizon (a draft_n=0 spec row would cap them at
+        # 1 token/step, inverting the back-off's purpose)
+        drafting = [
+            (i, r) for i, r in eligible if drafts.get(i, ([], None))[0]
+        ]
+        rest += [
+            (i, r) for i, r in eligible if not drafts.get(i, ([], None))[0]
+        ]
+        # admission-serial order: lane order drives per-row sampling keys,
+        # and serial order is the schedule-invariant one (see _decode_active)
+        rest.sort(key=lambda t: t[1].sched_serial)
+        rest = [
+            (i, r) for i, r in rest
+            if self.slots[i] is r and r.status is RequestStatus.RUNNING
+        ]
+        if rest:
+            self._decode_batch(rest, outputs)
+        drafting = [
+            (i, r) for i, r in drafting
+            if self.slots[i] is r and r.status is RequestStatus.RUNNING
+            and not r.is_finished
+        ]
+        if not drafting:
+            return
+        frame = self._launch_spec_frame(drafting, drafts, pipelined)
+        if frame is None:
+            return
+        if pipelined:
+            self.inflight = frame
+        else:
+            try:
+                self._consume_spec_frame(frame, outputs)
+            except Exception:
+                # stash: the quarantine handler's drop_inflight rewinds the
+                # launch fold before any retry refolds
+                self.inflight = frame
+                raise
+
+    def _launch_spec_frame(
+        self, drafting: list, drafts: dict, pipelined: bool
+    ) -> InFlightFrame | None:
+        """Dispatch ONE fused verify block for the lanes that proposed.  The
+        trace is keyed only on (B bucket, mp bucket, W): per-lane draft
+        counts ride device scalars and padded rows are inert, so the
+        compiled program stays stable while per-lane drafting comes and
+        goes."""
+        FAULTS.fire(
+            "engine.decode_step", rids=",".join(r.rid for _s, r in drafting)
+        )
+        # ensure pages for every lane's drafts + bonus FIRST, then re-filter:
+        # a later lane's ensure may preempt an earlier one already vetted
+        # (same two-phase rule as _launch_frame — a preempted lane must
+        # never ride the block, its page-table row is already reassigned)
+        survivors = []
+        for slot, req in drafting:
+            props, tier = drafts.get(slot, ([], None))
+            if self._ensure_seq_capacity(req, len(props) + 1):
+                survivors.append((slot, req, props, tier))
+        lanes, props_rows, tier_rows = [], [], []
+        for slot, req, props, tier in survivors:
+            if self.slots[slot] is not req or req.status is not RequestStatus.RUNNING:
+                continue  # evicted as a peer's preemption victim
+            lanes.append((slot, req))
+            props_rows.append(props)
+            tier_rows.append(tier)
+        if not lanes:
+            return None
+        B_real = len(lanes)
+        B = self.sched.decode_bucket(B_real)
+        W = max(2, self.sched.spec_max_draft + 1)  # compiled block width
+        mp_b = self._mp_bucket(max(
+            math.ceil(
+                min(r.seq_len + len(p) + 1, self.sched.max_seq_len) / self.ps
+            )
+            for (_s, r), p in zip(lanes, props_rows)
+        ))
+        tokens = np.zeros((B, W), np.int32)
+        draft_n = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        topks = np.full(B, -1, np.int32)
+        topps = np.ones(B, np.float32)
+        minps = np.zeros(B, np.float32)
+        page_tables = np.zeros((B, mp_b), np.int32)
+        use_mrope = any(r.mrope_delta for _s, r in lanes)
+        rope_delta = np.zeros(B, np.int32) if use_mrope else None
+        for idx, ((slot, req), props) in enumerate(zip(lanes, props_rows)):
+            sp = req.sampling
+            tokens[idx, 0] = req.output_ids[-1]
+            if props:
+                tokens[idx, 1:1 + len(props)] = props
+            draft_n[idx] = len(props)
+            positions[idx] = req.seq_len
+            temps[idx] = sp.temperature
+            topks[idx] = sp.top_k
+            topps[idx] = sp.top_p
+            minps[idx] = sp.min_p
+            page_tables[idx] = self.page_tables[slot][:mp_b]
+            if use_mrope:
+                rope_delta[idx] = req.mrope_delta
+        for idx in range(B_real, B):
+            # padded rows: positions beyond the table send every KV write to
+            # the garbage page, and the all-zero page-table row is inert
+            positions[idx] = mp_b * self.ps
+        mark = self.runner.rng_mark()
+        emitted, n_emit, lps = self.runner.decode_spec_async(
+            tokens, draft_n, positions, page_tables,
+            temps, topks, topps, minps,
+            rope_delta=rope_delta,
+        )
+        return InFlightFrame(
+            lanes=[(s, r, r.seq_len) for s, r in lanes],
+            toks=emitted, lps=lps, horizon=W, B=B, B_real=B_real,
+            mp_b=mp_b, rng_mark=mark, lookahead=pipelined, folds=1,
+            spec=True, n_emit=n_emit,
+            draft_ns=[len(p) for p in props_rows], tiers=tier_rows,
+        )
+
+    def _spec_frame_stale(self, frame: InFlightFrame) -> bool:
+        """Staleness for an in-flight SPEC frame: PER-LANE checks only.
+        Unlike the megastep lookahead, membership cannot GROW between launch
+        and consume — ``_step_spec`` consumes the frame BEFORE the step's
+        admissions/promotions and before the next round of drafting — so the
+        hazards are lanes that vanished or moved: deadline expiry, abort,
+        quarantine, preemption, stop-string rollback.  Any such lane
+        discards the frame (and rewinds its fold) exactly like a discarded
+        lookahead; rest-batch lanes never invalidate the verify block."""
+        if not frame.spec:
+            return True
+        for slot, req, expected in frame.lanes:
+            if (
+                self.slots[slot] is not req
+                or req.status is not RequestStatus.RUNNING
+                or req.is_finished
+                or req.seq_len != expected
+            ):
+                return True
+        return False
+
+    def _consume_spec_frame(
+        self, frame: InFlightFrame, outputs: list[StepOutput]
+    ) -> float:
+        """Deferred fetch + acceptance bookkeeping for one verify block.
+        Unlike the megastep's batch-wide trim, acceptance is PER LANE: each
+        lane's emitted run is its own accepted drafts + bonus/correction,
+        and ``_accept_tokens`` truncates at that lane's own finish (EOS /
+        stop token / length inside an accepted run) — a finish in lane A
+        never discards lane B's accepted tokens, because no cross-lane
+        recomposition happens inside a block."""
+        FAULTS.fire(
+            "engine.device_fetch",
+            rids=",".join(r.rid for _s, r, _e in frame.lanes),
+        )
+        t0 = time.perf_counter()
+        toks, lps, n_emit = jax.device_get(
+            (frame.toks, frame.lps, frame.n_emit)
+        )
+        fetch_s = time.perf_counter() - t0
+        if frame.lookahead:
+            self.num_lookahead_kept += 1
+        m = self.metrics
+        for idx, (_slot, req, _expected) in enumerate(frame.lanes):
+            # smglint: disable-next=HOTSYNC n_emit was device_get-fetched above
+            n = int(n_emit[idx])
+            drafted = frame.draft_ns[idx]
+            accepted = max(0, min(n - 1, drafted))
+            if drafted:
+                self.num_spec_drafted += drafted
+                self.num_spec_accepted += accepted
+                self._step_spec_drafted += drafted
+                self._step_spec_accepted += accepted
+                # rejected verify columns were computed but never emitted
+                self.num_wasted_decode_tokens += drafted - accepted
+                # acceptance back-off + the depth controller's EMA
+                req.spec_cold = 0 if accepted else req.spec_cold + 1
+                self._spec_accept_ema = (
+                    float(accepted) if self._spec_accept_ema == 0.0
+                    else 0.8 * self._spec_accept_ema + 0.2 * accepted
                 )
-                accepted, n_hits = accept_greedy(
-                    proposals, [int(a) for a in arg]
-                )
-            else:
-                final, n_hits = self.runner.verify_sample(
-                    chunk, prefix_len=req.seq_len,
-                    page_table=self.page_tables[slot][:mp_b],
-                    temperature=sp.temperature, top_k=sp.top_k,
-                    top_p=sp.top_p, min_p=sp.min_p,
-                    rope_pos=rope_pos,
-                )
-                accepted = proposals[:n_hits] + [final]
-            self.num_spec_drafted += len(proposals)
-            self.num_spec_accepted += n_hits
-            self.num_decode_tokens += len(accepted)
-            # adaptive back-off: a context whose drafts keep missing stops
-            # burning verify calls (cold streak resets on any acceptance)
-            req.spec_cold = 0 if n_hits else req.spec_cold + 1
-            self._accept_tokens(req, accepted, [0.0] * len(accepted),
-                                outputs, advance_seq=True)
-            if self.draft is not None and self.slots[slot] is req:
-                # draft KV coverage: fed [y0, d1..d_{k-1}] at positions
-                # seq_before.. — the committed stream matches it for y0 plus
-                # the accepted proposals (the final/bonus token was never
-                # fed).  Wrong coverage can only cost acceptance rate, never
+                if m is not None:
+                    m.observe_spec(frame.tiers[idx] or "ngram",
+                                   drafted, accepted)
+            before_out = len(req.output_ids)
+            self._accept_tokens(
+                req, [int(t) for t in toks[idx][:n]],
+                [float(x) for x in lps[idx][:n]], outputs,
+                advance_seq=True,
+            )
+            kept = len(req.output_ids) - before_out
+            self.num_decode_tokens += kept
+            # columns emitted by the block but truncated at a finish inside
+            # the accepted run were computed-and-dropped: waste, not output
+            self.num_wasted_decode_tokens += n - kept
+            if drafted and self.draft is not None and not req.is_finished:
+                # draft KV coverage: the tier fed [y0, drafts...] at the
+                # entry positions, so coverage extends over y0 plus the
+                # accepted drafts — capped at the fed range and the
+                # post-accept seq_len (a finish inside the run truncates).
+                # Wrong coverage only costs acceptance rate, never
                 # correctness (the target verify gates every token).
                 req.draft_len = min(
-                    seq_before + 1 + n_hits,
-                    seq_before + len(chunk) - 1,
-                    req.seq_len,
+                    _expected + 1 + accepted, _expected + drafted, req.seq_len
                 )
-        return rest
+        return fetch_s
+
+    def _step_spec(
+        self, outputs: list[StepOutput]
+    ) -> tuple[float, float, str | None]:
+        """One pipelined speculative iteration; returns (admit_s, fetch_s,
+        outcome).  Mirrors ``_step_overlap``'s shape: consume the in-flight
+        verify frame first (admission must see slots/pages its finishes
+        freed), run the prefill phase, then the spec decode phase leaves the
+        next verify block in flight.  Fold order — prefill, rest-megastep,
+        spec launch — is identical to the synchronous schedule's, so streams
+        are byte-identical to ``overlap_schedule off``."""
+        frame = self.inflight
+        self.inflight = None
+        fetch_s = 0.0
+        outcome = None
+        if frame is not None:
+            if self._spec_frame_stale(frame):
+                self._discard_frame(frame)
+                outcome = "discarded" if frame.lookahead else None
+            else:
+                try:
+                    fetch_s = self._consume_spec_frame(frame, outputs)
+                except Exception:
+                    # stash so the step-level handler's drop_inflight rewinds
+                    # the launch fold before the blame/retry refolds
+                    self.inflight = frame
+                    raise
+                outcome = "kept"
+        ta = time.perf_counter()
+        self._admit(outputs)
+        admit_s = time.perf_counter() - ta
+        self._spec_phase(outputs, pipelined=True)
+        return admit_s, fetch_s, outcome
 
     def _new_spec_index(self, req: EngineRequest, cfg) -> "object":
         from smg_tpu.engine.speculative import NgramIndex

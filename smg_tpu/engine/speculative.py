@@ -1,31 +1,30 @@
-"""Speculative decoding: prompt-lookup (n-gram) drafting + batched verify.
+"""Speculative decoding: prompt-lookup (n-gram) drafting + fused verify.
 
-Beyond-reference feature (the reference defers decoding strategies to its
-engines): greedy requests draft K tokens by n-gram lookup over their own
-context — the longest recent suffix n-gram that occurred earlier proposes
-the tokens that followed it — and the target model verifies all K in ONE
-prefill-shaped forward (MXU-batch instead of K sequential decode steps).
+The drafting half of the production two-tier speculation system
+(``Scheduler._spec_phase``).  The default zero-cost tier drafts K tokens by
+n-gram lookup over the request's own context — the longest recent suffix
+n-gram that occurred earlier proposes the tokens that followed it
+("Prompt Lookup Decoding"); ``NgramIndex`` makes the lookup incremental so
+the serving hot loop pays O(1) per accepted token.  A configured draft
+MODEL (``engine/draft.py``, ``EngineConfig.draft_model``) replaces n-gram
+lookup as the proposer (``SchedulerConfig.speculative_tier`` pins either).
 
-Correctness: greedy verification accepts exactly the greedy argmax chain,
-so speculative greedy output is token-identical to plain greedy decode (the
-engine's parity tests pin this).  Rejected positions' KV lands beyond
-``seq_len`` and is overwritten later — the same overshoot convention the
-stop-string rollback already relies on (KV past seq_len never enters the
-radix cache).
+Verification is BATCHED AND DEVICE-FUSED since the megastep integration
+(``runner.decode_spec_async``): every eligible lane's drafts ride one
+device block that scores all K positions in a single forward, accepts on
+device (greedy chains at temperature 0 — token-identical to plain greedy
+decode, the engine's parity tests pin this; distribution-preserving
+rejection sampling via ``engine/sampling.py::spec_accept_sample`` above
+it), and scatters only the ACCEPTED columns' KV into real cache slots —
+rejected columns mask to the garbage page, so a bad draft can never poison
+a slot or the radix cache.
 
-Since r5 sampling (temperature > 0) requests speculate too: acceptance runs
-ON DEVICE via rejection sampling specialized to a deterministic draft
-(``engine/sampling.py::spec_accept_sample`` — distribution-preserving,
-Monte-Carlo-pinned by tests), and a configured draft MODEL
-(``engine/draft.py``, ``EngineConfig.draft_model``) replaces n-gram lookup
-as the proposer.
-
-Overlap interaction: the speculative path FORCES A SYNC BOUNDARY in the
-overlapped decode pipeline (``scheduler.step`` falls back to the
-synchronous schedule when ``speculative`` is on).  Both the n-gram lookup
-and the verify-chunk construction consume last step's host-side results
-(accepted tokens, acceptance counts), so there is no device work that
-could be dispatched ahead of them.
+Overlap interaction: speculation NO LONGER forces a sync boundary.  The
+chained one-step lookahead is still impossible (drafting needs last step's
+accepted tokens host-side), but the verify frame itself stays in flight
+across steps (``Scheduler._step_spec``): host-side drafting, detokenize,
+and stream callbacks overlap the device's verify pass, and a discarded
+frame rewinds its sampling-key fold exactly like a discarded lookahead.
 """
 
 from __future__ import annotations

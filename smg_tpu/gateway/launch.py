@@ -79,6 +79,7 @@ def build_engine_from_args(args):
             decode_horizon_max=getattr(args, "decode_horizon_max", 0),
             speculative=getattr(args, "speculative", False),
             spec_max_draft=getattr(args, "spec_max_draft", 8),
+            speculative_tier=getattr(args, "speculative_tier", "auto"),
             overlap_schedule=getattr(args, "overlap_schedule", "on") != "off",
             max_queued_requests=getattr(args, "max_queued_requests", 0),
             max_queued_tokens=getattr(args, "max_queued_tokens", 0),

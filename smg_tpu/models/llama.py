@@ -28,6 +28,7 @@ from smg_tpu.ops.attention import (
     attention_decode_cached,
     attention_prefill,
     attention_prefill_batched,
+    attention_verify_block,
     gather_seq_kv,
     scatter_kv_pages_full,
 )
@@ -699,6 +700,76 @@ def forward_decode_horizon(
         )
     logits = unembed(params, cfg, h)
     return logits, hk_all, hv_all
+
+
+def forward_verify_block(
+    params: Params,
+    cfg: ModelConfig,
+    inv_freq: jnp.ndarray,
+    tokens: jnp.ndarray,  # [B, W] verify block per lane: [y0, d1.., pad]
+    entry_positions: jnp.ndarray,  # [B] cache token count at block entry
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D] READ-ONLY during the block
+    v_cache: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, mp]
+    rope_delta: jnp.ndarray | None = None,  # [B] M-RoPE decode offset per lane
+):
+    """Speculative verify block: score W tokens per lane in ONE forward.
+
+    The fused draft-verify analogue of ``forward_decode_horizon``: instead of
+    one token per call fed back serially, the block feeds the last committed
+    token plus the drafted columns at positions ``entry..entry+W-1`` and
+    returns every position's next-token logits — K drafted positions scored
+    for the cost class of a single decode step (decode is bandwidth-bound;
+    the extra columns ride the same weight pass).  The block's K/V stays in
+    SIDE BUFFERS (``attention_verify_block`` attends frozen cache + causal
+    block rows); the caller scatters accepted columns into the cache and
+    rejected columns to the garbage page AFTER acceptance is known, so a
+    rejected draft's KV never lands in a real slot.
+
+    Generated positions are text under M-RoPE (three equal axes), so a
+    per-lane ``rope_delta`` rides the standard rope path exactly as in
+    horizon decode.  LoRA / pp / pallas are not composed here: the scheduler
+    keeps adapter-pinned lanes on the non-speculative path, and pp engines
+    fall back likewise (see ``Scheduler._partition_spec``).
+    Returns (logits [B, W, V], bk [L, B, W, K*D], bv [L, B, W, K*D])."""
+    scale = cfg.query_scale or 1.0 / math.sqrt(cfg.head_dim)
+    K, D = cfg.num_kv_heads, cfg.head_dim
+    B, W = tokens.shape
+    L = cfg.num_layers
+
+    pos = entry_positions[:, None] + jnp.arange(W)[None, :]  # [B, W]
+    rope_positions = pos if rope_delta is None else pos + rope_delta[:, None]
+
+    h = embed_tokens(params, cfg, tokens)  # [B, W, E]
+    bk0 = jnp.zeros((L, B, W, K * D), k_cache.dtype)
+    bv0 = jnp.zeros((L, B, W, K * D), v_cache.dtype)
+
+    def layer_body(carry, xs):
+        h, bk_all, bv_all = carry
+        layer, l = xs
+        hn = _norm(h, layer["attn_norm"], cfg)
+        q, k, v = _qkv(layer, cfg, hn)  # [B, W, H/K, D]
+        q = apply_rope(q, rope_positions, inv_freq)
+        k = apply_rope(k, rope_positions, inv_freq)
+        k_f = k.reshape(B, W, K * D).astype(bk_all.dtype)
+        v_f = v.reshape(B, W, K * D).astype(bv_all.dtype)
+        bk_all = jax.lax.dynamic_update_slice(bk_all, k_f[None], (l, 0, 0, 0))
+        bv_all = jax.lax.dynamic_update_slice(bv_all, v_f[None], (l, 0, 0, 0))
+        bk_l = jax.lax.dynamic_index_in_dim(bk_all, l, 0, keepdims=False)
+        bv_l = jax.lax.dynamic_index_in_dim(bv_all, l, 0, keepdims=False)
+        attn = attention_verify_block(
+            q, k_cache, v_cache, bk_l, bv_l, l, page_tables, entry_positions,
+            scale, softcap=cfg.attn_logit_softcap, window=_layer_window(cfg, l),
+        )
+        h = _attn_residual(h, layer, attn, cfg)
+        h = _mlp_residual(h, layer, cfg)
+        return (h, bk_all, bv_all), None
+
+    (h, bk_all, bv_all), _ = jax.lax.scan(
+        layer_body, (h, bk0, bv0), (params["layers"], jnp.arange(L))
+    )
+    logits = unembed(params, cfg, h)  # [B, W, V]
+    return logits, bk_all, bv_all
 
 
 def forward_embed(

@@ -208,6 +208,72 @@ def attention_decode_cached(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def attention_verify_block(
+    q: jnp.ndarray,  # [B, W, H, D] one verify block per lane (post-rope)
+    k_cache: jnp.ndarray,  # [L, P, ps, K*D] read-only cache (fused lanes)
+    v_cache: jnp.ndarray,
+    bk: jnp.ndarray,  # [B, W, K*D] block side buffer (this layer)
+    bv: jnp.ndarray,
+    layer,  # scalar layer index
+    page_tables: jnp.ndarray,  # [B, mp]
+    entry_positions: jnp.ndarray,  # [B] cache token count at block entry
+    scale: float,
+    softcap: float | None = None,
+    window: jnp.ndarray | None = None,  # scalar sliding window (<=0 = global)
+) -> jnp.ndarray:
+    """Attention for a speculative verify block: W query tokens per lane
+    (the last committed token plus the drafted columns) against the lane's
+    frozen cache pages (positions < entry) PLUS the block's own K/V rows,
+    causal within the block.  The block K/V lives in side buffers, NOT the
+    cache — the caller scatters only the ACCEPTED columns after the
+    acceptance decision, which is how rejected drafts' KV ends up on the
+    garbage page instead of poisoning real slots.  The multi-query cousin of
+    ``attention_decode_cached`` (same gather, same joint softmax)."""
+    B, W, H, D = q.shape
+    L, P, ps, KD = k_cache.shape
+    K = KD // D
+    G = H // K
+    cd = k_cache.dtype  # cache-dtype matmuls, f32 accumulation (HBM-bound)
+    kl = k_cache[layer][page_tables]  # [B, mp, ps, KD]
+    vl = v_cache[layer][page_tables]
+    mp = kl.shape[1]
+    S = mp * ps
+    kl = kl.reshape(B, S, K, D)
+    vl = vl.reshape(B, S, K, D)
+    k_all = jnp.concatenate([kl, bk.reshape(B, W, K, D).astype(cd)], axis=1)
+    v_all = jnp.concatenate([vl, bv.reshape(B, W, K, D).astype(cd)], axis=1)
+    qf = q.astype(cd).reshape(B, W, K, G, D)
+    scores = jnp.einsum(
+        "bwkgd,bskd->bwkgs", qf, k_all, preferred_element_type=jnp.float32
+    ) * scale
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
+    j = jnp.arange(S + W)
+    w_idx = jnp.arange(W)
+    # cache keys: position j valid below the lane's entry; block keys: side
+    # row i visible to query column w iff i <= w (causal within the block)
+    mask = jnp.where(
+        j[None, None, :] < S,
+        j[None, None, :] < entry_positions[:, None, None],
+        (j[None, None, :] - S) <= w_idx[None, :, None],
+    )  # [B, W, S+W]
+    if window is not None:
+        key_pos = jnp.where(
+            j[None, None, :] < S,
+            j[None, None, :],
+            entry_positions[:, None, None] + (j[None, None, :] - S),
+        )
+        q_pos = entry_positions[:, None, None] + w_idx[None, :, None]
+        mask = mask & ((window <= 0) | (key_pos > q_pos - window))
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bwkgs,bskd->bwkgd", probs.astype(cd), v_all,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, W, H, D).astype(q.dtype)
+
+
 def attention_decode(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence (post-rope)
     k_pages: jnp.ndarray,  # [P, ps, KD]

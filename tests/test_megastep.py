@@ -72,7 +72,13 @@ def k1_baseline():
     return run_streams(make_engine(True), MIXED_JOBS)
 
 
-@pytest.mark.parametrize("horizon", [2, 4, 8])
+# tier-1 wall-clock: K=4 (both schedules) is the in-band gate; the K∈{2,8}
+# variants ride the slow lane with the exhaustive sweep (ROADMAP practical
+# note — the full suite must fit the 870s harness timeout)
+@pytest.mark.parametrize("horizon", [
+    pytest.param(2, marks=pytest.mark.slow), 4,
+    pytest.param(8, marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("overlap", [True, False])
 def test_k_sweep_byte_identical_to_k1(horizon, overlap, k1_baseline):
     got = run_streams(make_engine(overlap, decode_horizon=horizon), MIXED_JOBS)

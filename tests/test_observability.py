@@ -202,16 +202,20 @@ def _observe(em, *, prefill_tokens=0, decode_tokens=0, running=0, cumulative=Non
 def test_observe_step_converts_cumulatives_to_increments():
     em = EngineMetrics()
     _observe(em, prefill_tokens=32, decode_tokens=4, running=2,
-             cumulative={"spec_drafted": 10, "spec_accepted": 6,
-                         "radix_hit_pages": 3, "cached_prompt_tokens": 48})
+             cumulative={"radix_hit_pages": 3, "cached_prompt_tokens": 48})
     _observe(em, decode_tokens=4, running=2,
-             cumulative={"spec_drafted": 15, "spec_accepted": 9,
-                         "radix_hit_pages": 3, "cached_prompt_tokens": 48})
+             cumulative={"radix_hit_pages": 3, "cached_prompt_tokens": 48})
+    # spec acceptance is per-lane-per-verify-block, not cumulative-delta
+    em.observe_spec("ngram", 10, 6)
+    em.observe_spec("ngram", 5, 3)
     from prometheus_client import generate_latest
 
     body = generate_latest(em.registry).decode()
-    assert metric_value(body, "smg_engine_spec_draft_tokens_total") == 15.0
-    assert metric_value(body, "smg_engine_spec_accepted_tokens_total") == 9.0
+    assert metric_value(body, "smg_engine_spec_drafted_tokens_total",
+                        {"tier": "ngram"}) == 15.0
+    assert metric_value(body, "smg_engine_spec_accepted_tokens_total",
+                        {"tier": "ngram"}) == 9.0
+    assert metric_value(body, "smg_engine_spec_accepted_length_count") == 2.0
     assert metric_value(body, "smg_engine_radix_hit_pages_total") == 3.0
     assert metric_value(body, "smg_engine_cached_prompt_tokens_total") == 48.0
     assert metric_value(body, "smg_engine_prefill_tokens_total") == 32.0
@@ -517,8 +521,10 @@ def test_metrics_exports_engine_series_from_one_registry(gateway):
     assert metric_value(text, "smg_engine_cached_prompt_tokens_total") > 0
     assert metric_value(text, "smg_engine_radix_cached_pages") > 0
     # speculative decoding on a repetitive context drafts (and accepts)
-    assert metric_value(text, "smg_engine_spec_draft_tokens_total") > 0
-    assert metric_value(text, "smg_engine_spec_accepted_tokens_total") is not None
+    assert metric_value(text, "smg_engine_spec_drafted_tokens_total",
+                        {"tier": "ngram"}) > 0
+    assert metric_value(text, "smg_engine_spec_accepted_tokens_total",
+                        {"tier": "ngram"}) is not None
     # finish accounting
     assert metric_value(text, "smg_engine_requests_finished_total",
                         {"reason": "length"}) >= 2.0

@@ -1,7 +1,7 @@
 """Overlap-pipeline parity: the one-step-lookahead scheduler must produce
 token streams BYTE-IDENTICAL to the synchronous path in every scenario —
 greedy, seeded sampling, stop-string rollback mid-lookahead, abort of an
-in-flight request, the speculative sync boundary, and structured-output
+in-flight request, the pipelined speculative schedule, and structured-output
 forced sync.  Each test runs the same workload through a fresh engine with
 ``overlap_schedule`` on and off (fresh engines so the sampling-key counter
 starts identically) and compares full per-request streams."""
@@ -190,19 +190,20 @@ def test_abort_of_inflight_request():
     assert sched.pool.free_count + held == eng.runner.spec.num_pages - 1
 
 
-def test_speculative_forces_sync_boundary():
-    # the spec path's next device call depends on last step's host results,
-    # so overlap must transparently fall back to the synchronous schedule —
-    # identical streams, and the pipeline never engages
+def test_speculative_pipelines_with_parity():
+    # spec no longer forces sync: the batched verify frame stays in flight
+    # across steps (drafting/detokenize overlap the device pass), and the
+    # overlap-on stream must still be byte-identical to overlap-off
     rep = [5, 6, 7, 8] * 8
     jobs = [("sp", rep, greedy(16))]
     res = assert_parity(jobs, speculative=True, spec_max_draft=6)
     eng = make_engine(True, speculative=True, spec_max_draft=6)
     streams = run_streams(eng, jobs)
     assert streams == res
-    assert eng.scheduler.num_lookahead_kept == 0
-    assert eng.scheduler.inflight is None
+    assert eng.scheduler.num_lookahead_kept > 0  # the spec pipeline engaged
+    assert eng.scheduler.inflight is None  # drained clean
     assert eng.scheduler.num_spec_drafted > 0  # spec really ran
+    assert eng.scheduler.num_spec_accepted > 0  # repetitive prompt accepts
 
 
 def test_structured_output_forces_sync():
@@ -315,6 +316,7 @@ def test_engine_stop_drops_inflight():
     assert eng.scheduler.inflight is None
 
 
+@pytest.mark.slow  # subsumed by the temp-0.8 variant below (key-sensitive)
 def test_chunked_prefill_parity_greedy():
     # a multi-chunk prompt admits under the per-step budget (64) while a
     # short one decodes: the resumable-prefill steps are fold-free, so the
@@ -341,6 +343,8 @@ def test_chunked_prefill_parity_sampled():
     assert_parity(jobs, decode_horizon=2)
 
 
+@pytest.mark.slow  # legacy-policy variant; budgeted-vs-legacy parity also
+# rides tests/test_chunked_prefill.py in tier-1
 def test_chunked_prefill_parity_legacy_policy():
     # the legacy drain-the-queue policy must keep its own overlap/sync parity
     jobs = [

@@ -343,3 +343,274 @@ def test_ngram_index_survives_rollback():
     # same length as an earlier state but different content
     ids3 = ids2[:-1] + [7]
     assert propose_ngram(ids3, cfg, index=idx) == propose_ngram(ids3, cfg)
+
+
+# ---- fused batched verify: the megastep-integrated spec path (PR 11) ----
+#
+# Speculation no longer forces sync + K=1: all eligible lanes verify in ONE
+# fused device block with on-device acceptance, the verify frame pipelines
+# across steps under the overlapped schedule, and rejected columns' KV masks
+# to the garbage page.  The invariants pinned here: temp-0 byte-parity vs
+# non-spec across overlap modes, overlap-on/off byte-parity at temp 0.8,
+# exact mid-stream rejection handling, quarantine rewind of an in-flight
+# spec frame, and a 0-recompile / transfer-guard-clean steady state.
+
+import pytest
+
+from smg_tpu.faults import FAULTS
+from tests.test_megastep import assert_stream_parity
+from tests.test_overlap import greedy, make_engine, run_streams
+
+REP = [5, 6, 7, 8] * 8
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    FAULTS.clear()
+
+
+def test_spec_temp0_parity_vs_nonspec_overlap_matrix():
+    """Acceptance bar: spec-enabled temp-0 streams byte-identical to
+    non-spec, for overlap ON and OFF (the engine-gate fingerprint's unit
+    -test twin)."""
+    jobs = [
+        ("r0", REP, greedy(16)),
+        ("r1", [9] * 8, greedy(12)),
+        ("n0", list(range(40, 70)), greedy(10)),   # novel: drafts mostly miss
+        ("d0", [5, 6] + list(range(80, 100)) + [5, 6], greedy(8)),
+    ]
+    base = run_streams(make_engine(True), jobs)
+    for overlap in (True, False):
+        eng = make_engine(overlap, speculative=True, spec_max_draft=6)
+        got = run_streams(eng, jobs)
+        # tokens/text/finish exact; logprobs within 1e-3 (the verify block
+        # and the plain decode are different XLA programs — same tolerance
+        # as the megastep K-sweep)
+        assert_stream_parity(got, base, f"spec overlap={overlap} vs non-spec")
+        assert eng.scheduler.num_spec_drafted > 0
+
+
+def test_spec_temp08_overlap_on_off_parity():
+    """At temperature > 0 the rejection-sampled stream is not comparable to
+    non-spec, but overlap on/off WITH spec must stay byte-identical — the
+    pipelined verify frame consumes exactly the sync schedule's key folds."""
+    jobs = [
+        ("s0", REP, SamplingParams(temperature=0.8, top_k=40,
+                                   max_new_tokens=12, ignore_eos=True)),
+        ("s1", [11, 12, 13] * 9,
+         SamplingParams(temperature=0.8, min_p=0.02, max_new_tokens=9,
+                        ignore_eos=True)),
+        ("g0", [9] * 10, greedy(10)),
+    ]
+    on = run_streams(make_engine(True, speculative=True, spec_max_draft=6),
+                     jobs)
+    off = run_streams(make_engine(False, speculative=True, spec_max_draft=6),
+                      jobs)
+    assert on == off, "pipelined spec diverged from sync spec at temp 0.8"
+
+
+def test_spec_mid_stream_rejection_exact():
+    """A context whose repetition BREAKS forces mid-block rejections: the
+    correction token must land exactly where the non-spec stream puts it,
+    and the rejected columns must never surface."""
+    jobs = [
+        # repeats then diverges (the n-gram drafter keeps proposing the old
+        # continuation; the verify must reject it mid-block)
+        ("m0", [5, 6, 7, 8] * 4 + [5, 6, 7, 9, 5, 6, 7], greedy(18)),
+        ("m1", list(range(40, 64)) + [5, 6, 5, 6, 5, 7], greedy(14)),
+    ]
+    base = run_streams(make_engine(True), jobs)
+    eng = make_engine(True, speculative=True, spec_max_draft=6)
+    got = run_streams(eng, jobs)
+    assert_stream_parity(got, base, "mid-stream rejection")
+    sched = eng.scheduler
+    assert sched.num_spec_drafted > 0
+    # drafts really missed somewhere (and really hit somewhere): the whole
+    # point of the scenario
+    assert 0 < sched.num_spec_accepted < sched.num_spec_drafted
+
+
+def test_spec_quarantine_rewind_survivor_parity():
+    """A poison decode launch with a spec frame in flight: blame lands on
+    the newest lane, the stashed frame's sampling-key fold is rewound before
+    the retry refolds, and survivor streams stay byte-identical between the
+    pipelined and sync spec schedules at temp 0.8 — key-sensitive."""
+
+    def run(overlap: bool) -> dict:
+        eng = make_engine(overlap, speculative=True, spec_max_draft=4)
+        jobs = [
+            (f"q{i}", [5 + i, 6 + i, 7 + i, 8 + i] * 6,
+             SamplingParams(temperature=0.8, top_k=50, max_new_tokens=8,
+                            ignore_eos=True))
+            for i in range(3)
+        ]
+        chunks: dict = {rid: [] for rid, _, _ in jobs}
+        for rid, prompt, sp in jobs:
+            eng.submit(prompt, sp, rid=rid,
+                       on_output=lambda o, rid=rid: chunks[rid].append(o))
+        eng.step()  # admit + prefill all three
+        FAULTS.arm("engine.decode_step", mode="once")
+        for _ in range(300):
+            if all(v and v[-1].finished for v in chunks.values()):
+                break
+            eng.step()
+        while eng.scheduler.has_work():
+            eng.step()
+        FAULTS.clear()
+        assert eng.scheduler.num_quarantined == 1
+        assert eng.scheduler.inflight is None
+        return {
+            rid: ([t for o in v for t in o.new_token_ids],
+                  v[-1].finish_reason)
+            for rid, v in chunks.items()
+        }
+
+    piped, sync = run(True), run(False)
+    assert piped["q2"][1] == "error" and sync["q2"][1] == "error"
+    for rid in ("q0", "q1"):
+        assert piped[rid] == sync[rid], f"survivor {rid} diverged"
+
+
+def test_spec_steady_state_guard_clean():
+    """Steady-state decode WITH speculation on: 0 recompiles and no implicit
+    transfers across guarded steps (drafting is pure host work, the verify
+    launch uploads explicitly, and per-lane draft counts ride device
+    scalars so variable drafting never retraces)."""
+    from smg_tpu.analysis.runtime_guards import steady_state_guard
+
+    eng = make_engine(True, speculative=True, spec_max_draft=4)
+    # warm BOTH decode paths at the steady-state shapes: a novel prompt
+    # exercises the no-draft megastep fallback, a repetitive one the fused
+    # verify block
+    run_streams(eng, [("w0", list(range(30, 46)), greedy(6))])
+    run_streams(eng, [("w1", REP[:16], greedy(8))])
+    done: list = []
+    eng.submit(REP[:16], greedy(48), rid="g",
+               on_output=lambda o: done.append(o.finished))
+    for _ in range(4):  # prime the pipeline
+        eng.step()
+    with steady_state_guard() as cc:
+        for _ in range(6):
+            eng.step()
+    assert cc.count == 0, "speculative steady state recompiled"
+    while eng.scheduler.has_work():
+        eng.step()
+    assert done and done[-1]
+    assert eng.scheduler.num_spec_accepted > 0
+
+
+def test_spec_frame_ring_and_tier_metrics():
+    """Telemetry: the flight-recorder step ring carries spec_drafted/
+    spec_accepted (schema v3) and /metrics exposes the tier-labeled
+    families."""
+    from prometheus_client import generate_latest
+
+    eng = make_engine(True, speculative=True, spec_max_draft=6)
+    run_streams(eng, [("f0", REP, greedy(16))])
+    ring = eng.dump_flight()["ring"]
+    assert all("spec_drafted" in r and "spec_accepted" in r for r in ring)
+    assert any(r["spec_drafted"] > 0 for r in ring)
+    assert any(r["spec_accepted"] > 0 for r in ring)
+    text = generate_latest(eng.metrics.registry).decode()
+    assert 'smg_engine_spec_drafted_tokens_total{tier="ngram"}' in text
+    assert 'smg_engine_spec_accepted_tokens_total{tier="ngram"}' in text
+    assert "smg_engine_spec_accepted_length_count" in text
+
+
+def test_spec_composes_with_chunked_prefill_admissions():
+    """A multi-chunk prompt admits under the per-step budget while spec
+    frames fly: resumable chunks stay fold-free, the final sampling chunk
+    orders its fold before the next verify launch — streams match the sync
+    spec schedule exactly."""
+    jobs = [
+        ("long", list(range(5, 185)),
+         SamplingParams(temperature=0.8, top_k=40, max_new_tokens=8,
+                        ignore_eos=True)),
+        ("rep", REP, greedy(14)),
+        ("c1", [11, 12, 13] * 8,
+         SamplingParams(temperature=0.8, max_new_tokens=10, ignore_eos=True)),
+    ]
+    on = run_streams(make_engine(True, speculative=True, spec_max_draft=4),
+                     jobs)
+    off = run_streams(make_engine(False, speculative=True, spec_max_draft=4),
+                      jobs)
+    assert on == off
+
+
+def test_spec_stop_string_lane_keeps_k1_path():
+    """Stop-string lanes are spec-INELIGIBLE (engine-layer matches roll back
+    mid-block emissions) and ride the rest-batch megastep at K=1 — streams
+    still match non-spec exactly at temp 0."""
+    probe = run_streams(
+        make_engine(False), [("p", REP, greedy(10))]
+    )["p"][0]
+    stop_word = f"w{probe[4]}"
+    jobs = [
+        ("st", REP,
+         SamplingParams(temperature=0.0, max_new_tokens=16, ignore_eos=True,
+                        stop=[stop_word])),
+        ("rep", [9] * 10, greedy(12)),
+    ]
+    base = run_streams(make_engine(True), jobs)
+    got = run_streams(make_engine(True, speculative=True, spec_max_draft=6),
+                      jobs)
+    assert_stream_parity(got, base, "stop-string lane with spec on")
+    assert got["st"][2] == "stop"
+
+
+def test_spec_tier_and_flag_plumbing():
+    """--speculative-tier / --spec-max-draft-tokens reach SchedulerConfig;
+    tier 'draft' without a draft model is a validation error."""
+    from smg_tpu.cli import build_parser
+    from smg_tpu.config.validation import validate_cli_args
+
+    args = build_parser().parse_args([
+        "worker", "--model-preset", "tiny", "--speculative",
+        "--speculative-tier", "ngram", "--spec-max-draft-tokens", "5",
+    ])
+    assert not [i for i in validate_cli_args(args) if i.severity == "error"]
+    assert args.speculative_tier == "ngram" and args.spec_max_draft == 5
+
+    bad = build_parser().parse_args([
+        "worker", "--model-preset", "tiny", "--speculative",
+        "--speculative-tier", "draft",
+    ])
+    assert [i for i in validate_cli_args(bad) if i.severity == "error"]
+
+    with pytest.raises(ValueError):
+        SchedulerConfig(speculative_tier="bogus")
+    with pytest.raises(ValueError):
+        SchedulerConfig(spec_max_draft=0)
+
+    # engine-level resolution: ngram pin beats an installed draft model
+    eng = _draft_engine(draft_seed=0)
+    try:
+        assert eng.scheduler._spec_tier() == "draft"
+        import dataclasses
+
+        eng.scheduler.sched = dataclasses.replace(
+            eng.scheduler.sched, speculative_tier="ngram"
+        )
+        assert eng.scheduler._spec_tier() == "ngram"
+    finally:
+        eng.stop()
+
+
+def test_launch_wires_spec_tier_flag():
+    from smg_tpu.cli import build_parser
+    from smg_tpu.gateway.launch import build_engine_from_args
+
+    args = build_parser().parse_args([
+        "worker", "--model-preset", "tiny", "--dtype", "float32",
+        "--max-batch-size", "4", "--max-seq-len", "256",
+        "--speculative", "--speculative-tier", "ngram",
+        "--spec-max-draft-tokens", "6",
+    ])
+    eng = build_engine_from_args(args)
+    try:
+        sc = eng.config.scheduler
+        assert sc.speculative and sc.speculative_tier == "ngram"
+        assert sc.spec_max_draft == 6
+    finally:
+        eng.stop()
