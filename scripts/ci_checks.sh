@@ -2,8 +2,11 @@
 # Single CI entrypoint for the repo's self-checks:
 #
 #   1. smglint        — AST hot-path & concurrency rules over smg_tpu/
-#                       (HOTSYNC / ASYNCBLOCK / LOCKAWAIT / RETRACE),
-#                       failing on any unbaselined finding;
+#                       (HOTSYNC / ASYNCBLOCK / LOCKAWAIT / RETRACE plus the
+#                       smglint-v2 concurrency set: GUARDED lock-discipline
+#                       inference, FRAMEFOLD frame/fold lifecycle, LOCKORDER
+#                       acquisition-order inversions — all in the default
+#                       set), failing on any unbaselined finding;
 #   2. metric docs    — README observability table vs exported smg_* series;
 #   3. runtime guards — transfer-guard + zero-recompile probes on the real
 #                       engine's steady-state decode loop (the runtime teeth
@@ -22,7 +25,13 @@
 #                       smg_tpu/faults.py fault points: poison-step
 #                       quarantine (survivor byte-parity + zero leaks),
 #                       deadlines, backpressure, watchdog, drain
-#                       (tests/test_reliability.py);
+#                       (tests/test_reliability.py).  The suite runs with
+#                       SMG_LOCK_SENTINEL=1: every make_lock-adopted lock
+#                       (engine / flight recorder / breaker / worker /
+#                       registry / route+SLO observability) joins dynamic
+#                       lock-order tracking, and any inversion fails the
+#                       offending test at the acquisition that closes the
+#                       cycle, with both stacks;
 #   6. flight recorder — step-level black box + SLO accounting: ring-bound
 #                       under churn, dump-on-quarantine/watchdog/health-flip/
 #                       drain via faults.py, DumpFlight RPC + /debug/flight
@@ -66,8 +75,8 @@ echo "== megastep decode K-sweep parity =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_megastep.py -q \
     -m 'not slow' -p no:cacheprovider
 
-echo "== reliability / failure isolation =="
-JAX_PLATFORMS=cpu python -m pytest tests/test_reliability.py -q \
+echo "== reliability / failure isolation (lock-order sentinel armed) =="
+JAX_PLATFORMS=cpu SMG_LOCK_SENTINEL=1 python -m pytest tests/test_reliability.py -q \
     -m 'not slow' -p no:cacheprovider
 
 echo "== flight recorder / SLO accounting =="

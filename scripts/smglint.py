@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""Repo-native static analysis: hot-path sync, async-blocking, lock-domain
-and jit-retrace hazards.  Thin wrapper so CI can run it without installing
-the package; the implementation lives in ``smg_tpu/analysis/``.
+"""Repo-native static analysis: hot-path sync, async-blocking, lock-domain,
+jit-retrace, lock-discipline (GUARDED), frame/fold lifecycle (FRAMEFOLD),
+and lock-order inversion (LOCKORDER) hazards.  Thin wrapper so CI can run it
+without installing the package; the implementation lives in
+``smg_tpu/analysis/``.
 
     python scripts/smglint.py smg_tpu/
     python scripts/smglint.py smg_tpu/ --write-baseline
-    python scripts/smglint.py smg_tpu/gateway --rules ASYNCBLOCK,LOCKAWAIT
+    python scripts/smglint.py smg_tpu/gateway --rules GUARDED,LOCKORDER
+    python scripts/smglint.py smg_tpu/ --format sarif   # CI diff annotation
 """
 
 import sys

@@ -10,10 +10,18 @@ docs to the exported series:
 
 - an AST rule engine (``core``) with per-line ``# smglint: disable=RULE``
   suppressions and a checked-in baseline for grandfathered findings;
-- four rule families (``rules``): HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE;
+- seven rule families (``rules``): HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE,
+  plus the concurrency/lifecycle set — GUARDED (lock-discipline inference:
+  fields written under a lock must not be accessed lock-free), FRAMEFOLD
+  (every frame launch accounts for its sampling-key folds on every path,
+  exception edges included), LOCKORDER (nested lock acquisitions keep one
+  global order across the whole run);
 - runtime guards (``runtime_guards``) pairing the static pass with
   ``jax.transfer_guard`` + XLA-compile counting around the steady-state
-  decode loop, wired into tests and ``benches/bench_engine.py``.
+  decode loop, and a lockdep-style :func:`lock_order_sentinel` whose
+  :func:`make_lock` wrapper the engine/recorder/gateway locks adopt —
+  armed via the context manager or ``SMG_LOCK_SENTINEL=1``, any dynamic
+  lock-order inversion fails the suite with both acquisition stacks.
 
 Lint-only use (``scripts/smglint.py`` / the ``smglint`` console script) has
 no jax dependency; ``runtime_guards`` imports jax lazily.

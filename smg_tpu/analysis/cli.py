@@ -24,6 +24,70 @@ from smg_tpu.analysis.core import (
 
 DEFAULT_BASELINE = "smglint_baseline.json"
 
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings) -> dict:
+    """SARIF 2.1.0 payload for CI diff annotation (one run, one result per
+    finding; suppressed/baselined findings ride the ``suppressions`` block
+    when ``--show-suppressed`` includes them).  Columns are 1-based in
+    SARIF; ``Finding.col`` is 0-based."""
+    from smg_tpu.analysis.rules import ALL_RULES
+
+    used = sorted({f.rule for f in findings})
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": getattr(ALL_RULES.get(rid), "description", rid)
+            },
+        }
+        for rid in used
+    ]
+    rule_index = {rid: i for i, rid in enumerate(used)}
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        }
+        if f.snippet:
+            res["locations"][0]["physicalLocation"]["region"]["snippet"] = {
+                "text": f.snippet
+            }
+        if f.suppressed or f.baselined:
+            res["suppressions"] = [{
+                "kind": "inSource" if f.suppressed else "external",
+            }]
+        results.append(res)
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "smglint",
+                "informationUri": "https://github.com/lightseekorg/smg",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
 
 def _default_baseline_path(paths: list[str]) -> Path | None:
     """The checked-in baseline next to pyproject.toml, when one exists."""
@@ -40,7 +104,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="smglint",
         description="AST hot-path & concurrency lint for smg-tpu "
-                    "(HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE)",
+                    "(HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE, GUARDED, "
+                    "FRAMEFOLD, LOCKORDER)",
     )
     ap.add_argument("paths", nargs="+", help="files or directories to lint")
     ap.add_argument("--baseline", default=None,
@@ -53,7 +118,8 @@ def main(argv: list[str] | None = None) -> int:
                          "exit 0")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset (e.g. HOTSYNC,RETRACE)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"), default="text",
+                    help="sarif emits SARIF 2.1.0 for CI diff annotation")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also list suppressed and baselined findings")
     args = ap.parse_args(argv)
@@ -108,6 +174,8 @@ def main(argv: list[str] | None = None) -> int:
     shown = findings if args.show_suppressed else new
     if args.format == "json":
         print(json.dumps([f.__dict__ for f in shown], indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(shown), indent=2))
     else:
         for f in shown:
             print(f.render())

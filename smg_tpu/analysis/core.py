@@ -263,15 +263,25 @@ def _collect_suppressions(source: str, lines: list[str]) -> _Suppressions:
 # ---- entry points ----
 
 def lint_source(
-    source: str, relpath: str, config: LintConfig | None = None
+    source: str, relpath: str, config: LintConfig | None = None,
+    *, rules: list | None = None, _sup_out: dict | None = None,
 ) -> list[Finding]:
     """Lint one module's source; returns every finding with ``suppressed``
     already resolved (callers filter).  Syntax errors are reported as a
     pseudo-finding rather than raised — a broken file must fail the lint,
-    not crash it."""
+    not crash it.
+
+    ``rules`` lets ``lint_paths`` share ONE rule set across a whole run so
+    run-scoped rules (LOCKORDER) can accumulate cross-module state; a bare
+    ``lint_source`` call instantiates fresh rules and additionally drains
+    ``finalize()`` so single-module use sees the same findings a
+    single-module run would."""
     from smg_tpu.analysis.rules import registered_rules
 
     config = config or LintConfig()
+    standalone = rules is None
+    if standalone:
+        rules = registered_rules(config.rules)
     try:
         ctx = ModuleContext(source, relpath, config)
     except SyntaxError as e:
@@ -280,14 +290,32 @@ def lint_source(
             message=f"syntax error: {e.msg}",
         )]
     sup = _collect_suppressions(ctx.source, ctx.lines)
+    if _sup_out is not None:
+        _sup_out[ctx.relpath] = sup
     findings: list[Finding] = []
-    for rule in registered_rules(config.rules):
+    for rule in rules:
         for f in rule.check(ctx):
             if sup.covers(f):
                 f = replace(f, suppressed=True)
             findings.append(f)
+    if standalone:
+        for f in finalize_rules(rules):
+            if f.path == ctx.relpath and sup.covers(f):
+                f = replace(f, suppressed=True)
+            findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def finalize_rules(rules: list) -> list[Finding]:
+    """Drain run-end findings from rules with a ``finalize()`` hook
+    (cross-module analyses like LOCKORDER)."""
+    out: list[Finding] = []
+    for rule in rules:
+        fin = getattr(rule, "finalize", None)
+        if callable(fin):
+            out.extend(fin())
+    return out
 
 
 def _repo_root(start: Path) -> Path | None:
@@ -332,6 +360,11 @@ def lint_paths(
 ) -> list[Finding]:
     import tokenize
 
+    from smg_tpu.analysis.rules import registered_rules
+
+    config = config or LintConfig()
+    rules = registered_rules(config.rules)
+    sups: dict[str, _Suppressions] = {}
     findings: list[Finding] = []
     for abspath, rel in iter_python_files(paths):
         try:
@@ -345,7 +378,14 @@ def lint_paths(
                 message=f"cannot decode source: {e}",
             ))
             continue
-        findings.extend(lint_source(source, rel, config))
+        findings.extend(lint_source(source, rel, config, rules=rules,
+                                    _sup_out=sups))
+    # run-end cross-module findings, suppressible at the site they anchor to
+    for f in finalize_rules(rules):
+        sup = sups.get(f.path)
+        if sup is not None and sup.covers(f):
+            f = replace(f, suppressed=True)
+        findings.append(f)
     return findings
 
 

@@ -1,4 +1,4 @@
-"""Rule registry: four families, each a pure AST pattern matcher.
+"""Rule registry: seven families, each an AST pattern matcher.
 
 | id         | invariant it guards                                          |
 |------------|--------------------------------------------------------------|
@@ -6,6 +6,15 @@
 | ASYNCBLOCK | ``async def`` bodies never call blocking APIs                |
 | LOCKAWAIT  | lock kind matches execution domain (thread vs event loop)    |
 | RETRACE    | ``jax.jit`` is constructed once, not per call/iteration      |
+| GUARDED    | lock-guarded fields are not accessed lock-free               |
+| FRAMEFOLD  | frame launches account for their sampling-key folds          |
+| LOCKORDER  | nested lock acquisitions keep one global order               |
+
+``registered_rules`` returns FRESH instances per call: LOCKORDER is
+run-scoped (it accumulates nested-acquisition pairs across every module in
+one ``lint_paths`` run and emits cross-module inversions from
+``finalize()``), so sharing instances across runs would leak one lint's
+pairs into the next.
 """
 
 from __future__ import annotations
@@ -13,23 +22,29 @@ from __future__ import annotations
 from typing import Iterable
 
 from smg_tpu.analysis.rules.asyncblock import AsyncBlockRule
+from smg_tpu.analysis.rules.framefold import FrameFoldRule
+from smg_tpu.analysis.rules.guarded import GuardedRule
 from smg_tpu.analysis.rules.hotsync import HotSyncRule
 from smg_tpu.analysis.rules.lockawait import LockAwaitRule
+from smg_tpu.analysis.rules.lockorder import LockOrderRule
 from smg_tpu.analysis.rules.retrace import RetraceRule
 
-ALL_RULES = {
-    r.id: r
-    for r in (HotSyncRule(), AsyncBlockRule(), LockAwaitRule(), RetraceRule())
-}
+_RULE_CLASSES = (
+    HotSyncRule, AsyncBlockRule, LockAwaitRule, RetraceRule,
+    GuardedRule, FrameFoldRule, LockOrderRule,
+)
+
+#: id -> class (instantiate per run; see module docstring)
+ALL_RULES = {cls.id: cls for cls in _RULE_CLASSES}
 
 
 def registered_rules(only: Iterable[str] | None = None):
     if only is None:
-        return list(ALL_RULES.values())
+        return [cls() for cls in _RULE_CLASSES]
     unknown = set(only) - set(ALL_RULES)
     if unknown:
         raise KeyError(f"unknown smglint rule(s): {sorted(unknown)}")
-    return [ALL_RULES[r] for r in only]
+    return [ALL_RULES[r]() for r in only]
 
 
 __all__ = ["ALL_RULES", "registered_rules"]

@@ -1,0 +1,279 @@
+"""FRAMEFOLD: frame-and-fold lifecycle over the scheduler protocol.
+
+The overlapped decode pipeline's correctness rests on one invariant that
+three separate parity bugs (PR 2, PR 7, PR 11) violated before it was
+spelled out: **every launch that consumes sampling-key counter folds must be
+accounted for on every path** — accepted (consumed and trimmed), stashed on
+``self.inflight`` (so ``drop_inflight`` can rewind it), or explicitly
+rewound (``_discard_frame`` / ``_rewind_unused_folds``) — *including the
+exception edges*, because the quarantine handler refolds keys on retry and
+an unrewound frame silently diverges every temp>0 stream after it.
+
+The rule is a lexical state machine over the protocol's names (this is a
+repo-native linter; the names ARE the protocol):
+
+- launchers  — ``_launch_frame`` / ``_launch_lookahead`` /
+  ``_launch_spec_frame``: create fold debt, return an ``InFlightFrame``;
+- consumers  — ``_consume_frame`` / ``_consume_spec_frame``: materialize a
+  frame's results (the deferred device fetch — the statement most likely to
+  raise);
+- rewinders  — ``_discard_frame`` / ``_rewind_unused_folds`` /
+  ``drop_inflight``: return counter values;
+- raw folds  — ``_consume_folds``: the counter advance itself.
+
+Checks, per function:
+
+F1  a launcher call whose result is discarded (bare statement) — the folds
+    it consumed can never be rewound;
+F2  a launched frame variable that is never referenced again — not
+    consumed, stashed, returned, or rewound on ANY path;
+F3  a consumer call not protected by a ``try`` whose handler stashes a
+    frame onto ``self.inflight`` or calls a rewinder — the exception edge
+    leaks the launch's folds (the exact shape of the PR 5 quarantine bug);
+F4  a ``_consume_frame`` site in a function that never calls
+    ``_rewind_unused_folds`` — a finish that trims the horizon leaves the
+    unused tail folds consumed (the PR 7 parity bug);
+F5  a ``return``/``raise`` lexically between a launch and the frame's first
+    resolution that does not mention the frame — an early exit dropping
+    fold debt;
+F6  a raw ``_consume_folds`` result that is discarded or never used — a
+    mark that can never be restored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext
+
+LAUNCHERS = {"_launch_frame", "_launch_lookahead", "_launch_spec_frame"}
+CONSUMERS = {"_consume_frame", "_consume_spec_frame"}
+REWINDERS = {"_discard_frame", "_rewind_unused_folds", "drop_inflight"}
+RAW_FOLD = "_consume_folds"
+#: attribute names that count as the pipeline stash (drop_inflight's domain)
+STASH_ATTRS = {"inflight"}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _own_nodes(fn) -> list[ast.AST]:
+    """Every node lexically in ``fn``, not descending into nested defs."""
+    out: list[ast.AST] = []
+    stack = list(fn.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {
+        n.id for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _handler_resolves(handler: ast.ExceptHandler) -> bool:
+    """True when the except body stashes a frame or rewinds folds."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and t.attr in STASH_ATTRS:
+                    return True
+        elif isinstance(n, ast.Call) and _call_name(n) in REWINDERS:
+            return True
+    return False
+
+
+class FrameFoldRule:
+    id = "FRAMEFOLD"
+    description = "sampling-key fold debt unaccounted on some path"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, fn)
+
+    def _check_function(self, ctx: ModuleContext, fn) -> Iterator[Finding]:
+        nodes = _own_nodes(fn)
+        calls = [n for n in nodes if isinstance(n, ast.Call)]
+        if not any(
+            _call_name(c) in LAUNCHERS | CONSUMERS or _call_name(c) == RAW_FOLD
+            for c in calls
+        ):
+            return
+
+        # F1 / F6: bare-statement launcher / raw-fold calls
+        for n in nodes:
+            if isinstance(n, ast.Expr) and isinstance(n.value, ast.Call):
+                cname = _call_name(n.value)
+                if cname in LAUNCHERS:
+                    yield ctx.finding(
+                        self.id, n,
+                        f"{cname}(...) result discarded — the launch consumed "
+                        "sampling-key folds that can now never be rewound; "
+                        "bind the frame and consume, stash, or discard it",
+                    )
+                elif cname == RAW_FOLD:
+                    yield ctx.finding(
+                        self.id, n,
+                        f"{RAW_FOLD}(...) mark discarded — without the "
+                        "pre-advance mark the counter cannot be restored on "
+                        "a discard/trim path",
+                    )
+
+        # launched frame variables: var -> (assign stmt, launcher name)
+        frames: dict[str, tuple[ast.Assign, str]] = {}
+        for n in nodes:
+            if (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                cname = _call_name(n.value)
+                if cname in LAUNCHERS:
+                    frames[n.targets[0].id] = (n, cname)
+                elif cname == RAW_FOLD:
+                    # F6 (captured form): the mark must be used somewhere
+                    var = n.targets[0].id
+                    if not self._referenced_after(nodes, var, n.lineno):
+                        yield ctx.finding(
+                            self.id, n,
+                            f"{RAW_FOLD} mark `{var}` is never used — the "
+                            "counter advance cannot be rewound or recorded",
+                        )
+
+        for var, (assign, launcher) in frames.items():
+            resolution = self._first_resolution(nodes, var, assign.lineno)
+            # F2: never referenced again at all
+            if resolution is None:
+                if self._referenced_after(nodes, var, assign.lineno):
+                    # referenced (e.g. `if frame is None`) but never resolved
+                    yield ctx.finding(
+                        self.id, assign,
+                        f"frame `{var}` from {launcher} is never consumed, "
+                        "stashed on self.inflight, returned, or rewound — "
+                        "its key folds leak on every path",
+                    )
+                else:
+                    yield ctx.finding(
+                        self.id, assign,
+                        f"frame `{var}` from {launcher} is never referenced "
+                        "again — launch fold debt with no accept or rewind",
+                    )
+                continue
+            # F5: early exit between launch and first resolution.  A return
+            # under a test that references the frame is the None-guard
+            # (`if frame is None: return`) — the launcher bailed before
+            # consuming folds, nothing to rewind.
+            for n in nodes:
+                if (isinstance(n, (ast.Return, ast.Raise))
+                        and assign.lineno < n.lineno < resolution
+                        and var not in _names_in(n)
+                        and not self._guarded_by_var(ctx, n, var)):
+                    kw = "return" if isinstance(n, ast.Return) else "raise"
+                    yield ctx.finding(
+                        self.id, n,
+                        f"{kw} between the {launcher} launch of `{var}` "
+                        f"(line {assign.lineno}) and its first "
+                        "accept/stash/rewind — this exit path leaks the "
+                        "frame's key folds",
+                    )
+
+        # F3: consumer calls need an exception edge that stashes or rewinds
+        consumed_fn_names = set()
+        for c in calls:
+            cname = _call_name(c)
+            if cname not in CONSUMERS:
+                continue
+            consumed_fn_names.add(cname)
+            protected = False
+            for anc in ctx.ancestors(c):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if isinstance(anc, ast.Try):
+                    # the call must be in the try BODY (a consumer inside the
+                    # handler is already on the recovery path)
+                    if any(c in ast.walk(b) for b in anc.body) and any(
+                        _handler_resolves(h) for h in anc.handlers
+                    ):
+                        protected = True
+                        break
+            if not protected:
+                yield ctx.finding(
+                    self.id, c,
+                    f"{cname}(...) without exception-edge protection: the "
+                    "deferred fetch can raise, and no enclosing try stashes "
+                    "the frame on self.inflight or rewinds its folds before "
+                    "the quarantine path refolds",
+                )
+
+        # F4: _consume_frame in a function with no horizon-trim rewind
+        if "_consume_frame" in consumed_fn_names:
+            if not any(_call_name(c) == "_rewind_unused_folds" for c in calls):
+                site = next(
+                    c for c in calls if _call_name(c) == "_consume_frame"
+                )
+                yield ctx.finding(
+                    self.id, site,
+                    "_consume_frame without a _rewind_unused_folds call in "
+                    "the same function — a finish that trims the horizon "
+                    "leaves the unused tail folds consumed (temp>0 streams "
+                    "diverge from the K=1 schedule)",
+                )
+
+    def _guarded_by_var(self, ctx: ModuleContext, node: ast.AST, var: str) -> bool:
+        """True when ``node`` sits under an If/While whose test references
+        ``var`` — the exit is conditioned on the frame's own state."""
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(anc, (ast.If, ast.While)) and var in _names_in(anc.test):
+                return True
+        return False
+
+    # ---- lexical reference scanning ----
+
+    def _first_resolution(
+        self, nodes: list[ast.AST], var: str, after_line: int
+    ) -> int | None:
+        """Line of the first event that transfers or settles ownership of
+        ``var``: passed to a call, stashed on an attribute, returned, or
+        re-bound to another name."""
+        best: int | None = None
+
+        def consider(line: int) -> None:
+            nonlocal best
+            if line > after_line and (best is None or line < best):
+                best = line
+
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if isinstance(a, ast.Name) and a.id == var:
+                        consider(n.lineno)
+            elif isinstance(n, ast.Assign):
+                if isinstance(n.value, ast.Name) and n.value.id == var:
+                    consider(n.lineno)
+            elif isinstance(n, ast.Return) and n.value is not None:
+                if var in _names_in(n.value):
+                    consider(n.lineno)
+        return best
+
+    def _referenced_after(
+        self, nodes: list[ast.AST], var: str, after_line: int
+    ) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id == var
+            and isinstance(n.ctx, ast.Load) and n.lineno > after_line
+            for n in nodes
+        )

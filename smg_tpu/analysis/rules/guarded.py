@@ -1,0 +1,414 @@
+"""GUARDED: per-class lock-discipline inference (RacerD-style, AST-scale).
+
+The engine is concurrent in specific, repeating shapes: a step thread owns
+the scheduler, a watchdog thread reads progress stamps, the gateway's event
+loop and health monitor mutate worker state, scrape threads read hand-rolled
+counters.  The recurring bug is a field that is *usually* written under a
+lock and then read (or written) lock-free from another thread.
+
+Inference, per class that owns at least one lock attribute:
+
+1. **Lock census** — ``self.L = threading.Lock()/RLock()/Condition(...)``
+   (plus ``make_lock(...)`` from ``analysis/runtime_guards``).  A
+   ``threading.Condition(self._lock)`` built ON another lock attr aliases
+   it: holding the condition IS holding the lock.
+2. **Access walk** — every ``self.F`` read/write in every method, with the
+   set of lock attrs held at that point (lexical ``with self.L:`` nesting).
+   Container mutation (``self.ring.append(...)``, ``self.d[k] = v``) counts
+   as a write.  ``__init__`` is pre-publication and ignored entirely.
+3. **Locked-context fixed point** — a private helper (``_state_locked``)
+   whose every in-class call site holds the lock is analyzed as holding it
+   too, so the ``*_locked`` convention needs no annotations.
+4. **Majority-of-writes** — a field whose writes are majority under one
+   lock is *guarded by* it; every access outside that lock is a finding.
+   The explicit escape ``# smglint: guarded-by(_lock)`` on the field's
+   assignment line forces the guard regardless of census (for fields the
+   census can't see, e.g. written from another module).
+
+Severity: an access in a method reachable (intra-module) from a
+``threading.Thread(target=...)`` / ``executor.submit(...)`` entry point is
+tagged ``[cross-thread]`` — those are the reports worth waking up for; the
+rest indicate discipline drift that becomes a race the day a thread is
+added.  Both fail CI; deliberate lock-free designs carry a justified
+``# smglint: disable=GUARDED`` on the access.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext, dotted_name
+from smg_tpu.analysis.rules.locks_common import (
+    class_lock_attrs,
+    condition_aliases,
+)
+
+_GUARDED_BY_RE = re.compile(r"#\s*smglint:\s*guarded-by\((\w+)\)")
+
+#: attribute method names whose call mutates the receiver container
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+_INIT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+@dataclass
+class _Access:
+    field: str
+    write: bool
+    held: frozenset  # normalized lock attr names held lexically
+    method: str
+    node: ast.AST
+
+
+class _MethodWalk(ast.NodeVisitor):
+    """One method body: accesses + in-class call sites with held-lock sets.
+    Nested defs are walked too (they close over ``self``) but a nested def
+    body does NOT inherit the lexical lock state of its definition point —
+    it runs on whatever thread calls it, possibly much later."""
+
+    def __init__(self, rule: "GuardedRule", method: str, lock_attrs, aliases):
+        self.rule = rule
+        self.method = method
+        self.lock_attrs = lock_attrs
+        self.aliases = aliases
+        self.held: tuple[str, ...] = ()
+        self.accesses: list[_Access] = []
+        self.calls: list[tuple[str, frozenset]] = []  # (callee, held)
+
+    # ---- lock state ----
+
+    def _lock_name(self, expr: ast.AST) -> str | None:
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and expr.attr in self.lock_attrs):
+            return self.aliases.get(expr.attr, expr.attr)
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._with(node)
+
+    def _with(self, node) -> None:
+        taken = []
+        for item in node.items:
+            name = self._lock_name(item.context_expr)
+            if name is not None:
+                taken.append(name)
+        self.held = self.held + tuple(taken)
+        for stmt in node.body:
+            self.visit(stmt)
+        if taken:
+            self.held = self.held[: len(self.held) - len(taken)]
+
+    # ---- nested defs: fresh lock state ----
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._nested(node)
+
+    def _nested(self, node) -> None:
+        saved = self.held
+        self.held = ()
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    # ---- accesses ----
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            field = node.attr
+            if field not in self.lock_attrs:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                self.accesses.append(_Access(
+                    field, write, frozenset(self.held), self.method, node
+                ))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self.F[k] = v / del self.F[k]: a write to F's contents
+        v = node.value
+        if (isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name) and v.value.id == "self"
+                and v.attr not in self.lock_attrs):
+            self.accesses.append(_Access(
+                v.attr, True, frozenset(self.held), self.method, v
+            ))
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if (isinstance(recv, ast.Name) and recv.id == "self"):
+                # self.m(...): in-class call site (the attribute load of the
+                # bound method is not a field access)
+                self.calls.append((f.attr, frozenset(self.held)))
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self" and f.attr in _MUTATORS
+                    and recv.attr not in self.lock_attrs):
+                # self.F.append(...): container mutation = write
+                self.accesses.append(_Access(
+                    recv.attr, True, frozenset(self.held), self.method, recv
+                ))
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        self.generic_visit(node)
+
+
+class GuardedRule:
+    id = "GUARDED"
+    description = "field guarded by a lock accessed outside it"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        thread_entries = _thread_entry_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node, thread_entries)
+
+    # ---- per-class analysis ----
+
+    def _check_class(
+        self, ctx: ModuleContext, cls: ast.ClassDef, thread_entries: set[str]
+    ) -> Iterator[Finding]:
+        lock_attrs = class_lock_attrs(cls)
+        if not any(k == "thread" for k in lock_attrs.values()):
+            return  # no thread lock: nothing to infer a discipline against
+        aliases = condition_aliases(cls, lock_attrs)
+        annotations = _guarded_by_annotations(ctx, cls, lock_attrs, aliases)
+
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        method_names = {m.name for m in methods}
+        walks: dict[str, _MethodWalk] = {}
+        for m in methods:
+            w = _MethodWalk(self, m.name, lock_attrs, aliases)
+            for stmt in m.body:
+                w.visit(stmt)
+            walks[m.name] = w
+
+        eff = _locked_context_fixed_point(
+            walks, method_names, thread_entries & method_names
+        )
+
+        # write census (constructor excluded: pre-publication writes say
+        # nothing about the concurrent discipline)
+        writes: dict[str, list[frozenset]] = {}
+        for name, w in walks.items():
+            if name in _INIT_METHODS:
+                continue
+            held_extra = eff.get(name, frozenset())
+            for a in w.accesses:
+                if a.write:
+                    writes.setdefault(a.field, []).append(a.held | held_extra)
+
+        guards: dict[str, tuple[str, int, int]] = {}  # field -> (lock, n, total)
+        for field, sets in writes.items():
+            total = len(sets)
+            counts: dict[str, int] = {}
+            for held in sets:
+                for lk in held:
+                    counts[lk] = counts.get(lk, 0) + 1
+            if not counts:
+                continue
+            lock, n = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+            if n * 2 > total:
+                guards[field] = (lock, n, total)
+        for field, lock in annotations.items():
+            n, total = 0, 0
+            if field in guards and guards[field][0] == lock:
+                _, n, total = guards[field]
+            guards[field] = (lock, n, total)
+
+        if not guards:
+            return
+
+        reachable = _cross_thread_reachable(walks, method_names, thread_entries)
+
+        for name, w in walks.items():
+            if name in _INIT_METHODS:
+                continue
+            held_extra = eff.get(name, frozenset())
+            for a in w.accesses:
+                g = guards.get(a.field)
+                if g is None:
+                    continue
+                lock, n, total = g
+                if lock in (a.held | held_extra):
+                    continue
+                basis = (
+                    f"guards {n}/{total} writes" if total
+                    else "guarded-by annotation"
+                )
+                via = ""
+                if name in reachable:
+                    via = f" [cross-thread: reachable from {reachable[name]}]"
+                kind = "write to" if a.write else "read of"
+                yield ctx.finding(
+                    self.id, a.node,
+                    f"{kind} self.{a.field} outside self.{lock} "
+                    f"({basis}) in {cls.name}.{name}{via} — take the lock, "
+                    "or suppress with a why-comment if the lock-free access "
+                    "is deliberate",
+                )
+
+
+# ---- helpers ----
+
+def _guarded_by_annotations(
+    ctx: ModuleContext, cls: ast.ClassDef, lock_attrs: dict[str, str],
+    aliases: dict[str, str],
+) -> dict[str, str]:
+    """``self.F = ...  # smglint: guarded-by(_lock)`` anywhere in the class
+    forces F's guard (normalized through condition aliases)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        last = getattr(node, "end_lineno", None) or node.lineno
+        m = None
+        for line in range(node.lineno, last + 1):
+            m = _GUARDED_BY_RE.search(ctx.line_at(line))
+            if m:
+                break
+        if not m:
+            continue
+        lock = m.group(1)
+        if lock not in lock_attrs:
+            continue  # unknown lock name: annotation is inert
+        lock = aliases.get(lock, lock)
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out[t.attr] = lock
+    return out
+
+
+def _locked_context_fixed_point(
+    walks: dict[str, "_MethodWalk"], method_names: set[str],
+    thread_entries: set[str],
+) -> dict[str, frozenset]:
+    """Effective extra-held locks per method: a private helper whose every
+    in-class call site (transitively) holds lock L is analyzed as holding L.
+    Public methods, uncalled methods, and THREAD-ENTRY methods (Thread
+    targets / executor submissions — another thread calls them with nothing
+    held, whatever their in-class call sites hold) are external entry
+    points (held = {}); cycles settle at {} (conservative: more findings,
+    never fewer... on the HELPER, which is where the access actually is)."""
+    callers: dict[str, list[tuple[str, frozenset]]] = {}
+    for caller, w in walks.items():
+        for callee, held in w.calls:
+            if callee in method_names:
+                callers.setdefault(callee, []).append((caller, held))
+    eff: dict[str, frozenset] = {name: frozenset() for name in walks}
+    for _ in range(8):
+        changed = False
+        for name in walks:
+            if not name.startswith("_") or name.startswith("__"):
+                continue  # public / dunder: externally callable, held = {}
+            if name in thread_entries:
+                continue  # a thread invokes it lock-free: entry point
+            sites = callers.get(name)
+            if not sites:
+                continue
+            new = None
+            for caller, held in sites:
+                site_locks = held | eff.get(caller, frozenset())
+                new = site_locks if new is None else (new & site_locks)
+            new = new or frozenset()
+            if new != eff[name]:
+                eff[name] = new
+                changed = True
+        if not changed:
+            break
+    return eff
+
+
+def _thread_entry_names(tree: ast.Module) -> set[str]:
+    """Method/function names handed to another thread in this module:
+    ``threading.Thread(target=X)``, ``executor.submit(X, ...)``,
+    ``loop.run_in_executor(_, X)``, ``asyncio.to_thread(X, ...)``."""
+    out: set[str] = set()
+
+    def _name_of(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func).rpartition(".")[2]
+        if fname == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    n = _name_of(kw.value)
+                    if n:
+                        out.add(n)
+        elif fname in ("submit", "to_thread") and node.args:
+            n = _name_of(node.args[0])
+            if n:
+                out.add(n)
+        elif fname == "run_in_executor" and len(node.args) >= 2:
+            n = _name_of(node.args[1])
+            if n:
+                out.add(n)
+    return out
+
+
+def _cross_thread_reachable(
+    walks: dict[str, "_MethodWalk"], method_names: set[str],
+    thread_entries: set[str],
+) -> dict[str, str]:
+    """method -> entry-point name, for every method reachable through
+    in-class calls from a thread entry."""
+    out: dict[str, str] = {}
+    for entry in sorted(thread_entries & method_names):
+        stack = [entry]
+        while stack:
+            cur = stack.pop()
+            if cur in out:
+                continue
+            out[cur] = entry
+            for callee, _held in walks.get(cur, _EMPTY_WALK).calls:
+                if callee in method_names and callee not in out:
+                    stack.append(callee)
+    return out
+
+
+class _EmptyWalk:
+    calls: list = []
+
+
+_EMPTY_WALK = _EmptyWalk()

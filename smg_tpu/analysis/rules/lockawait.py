@@ -27,27 +27,9 @@ from smg_tpu.analysis.core import (
     Finding,
     ModuleContext,
     contains_await,
-    dotted_name,
 )
-
-_THREAD_LOCKS = {
-    "threading.Lock", "threading.RLock", "threading.Semaphore",
-    "threading.BoundedSemaphore", "threading.Condition",
-}
-_ASYNC_LOCKS = {
-    "asyncio.Lock", "asyncio.Semaphore", "asyncio.BoundedSemaphore",
-    "asyncio.Condition",
-}
-
-
-def _lock_kind(value: ast.AST) -> str | None:
-    if isinstance(value, ast.Call):
-        name = dotted_name(value.func)
-        if name in _THREAD_LOCKS:
-            return "thread"
-        if name in _ASYNC_LOCKS:
-            return "async"
-    return None
+from smg_tpu.analysis.rules.locks_common import lock_kind as _lock_kind
+from smg_tpu.analysis.rules.locks_common import lock_ref
 
 
 class LockAwaitRule:
@@ -96,12 +78,7 @@ class LockAwaitRule:
         module_kinds: dict[str, str],
     ) -> tuple[str, str] | None:
         """(kind, display-name) when ``expr`` is a known lock reference."""
-        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
-                and expr.value.id == "self" and expr.attr in attr_kinds):
-            return attr_kinds[expr.attr], f"self.{expr.attr}"
-        if isinstance(expr, ast.Name) and expr.id in module_kinds:
-            return module_kinds[expr.id], expr.id
-        return None
+        return lock_ref(expr, attr_kinds, module_kinds)
 
     def _check_scope(
         self, ctx: ModuleContext, fn, attr_kinds: dict[str, str],

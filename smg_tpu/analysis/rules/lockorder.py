@@ -1,0 +1,139 @@
+"""LOCKORDER: static lock-acquisition-order inversion detection.
+
+Deadlock by inversion needs two threads and two locks taken in opposite
+orders — which means no single acquisition site is ever wrong by itself, so
+grep can't find it and tests only trip it under exactly the interleaving
+that hangs CI.  This rule collects every *nested* acquisition pair visible
+lexically (``with self._lock: ... with OTHER: ...`` and multi-item
+``with a, b:``) across the WHOLE lint run, then reports any pair observed
+in both orders, pointing at both sites.
+
+Lock identity is lockdep-style: by *class* of lock, not instance —
+``ClassName.attr`` for ``self.X`` locks (two instances of the same class
+alias, which is exactly what you want: the order contract is per lock
+class; class-attr locks therefore unify across modules so a cross-module
+inversion on a shared object still surfaces) and the full repo-relative
+path for module-level locks (``pkg/mod.py::NAME`` — same-named modules in
+different directories must NOT alias into phantom inversions).
+
+The rule is RUN-SCOPED: ``check`` only ACCUMULATES nested pairs — every
+finding, same-module or cross-module, is emitted from ``finalize``, which
+``lint_paths`` calls once after the whole run (and ``lint_source`` drains
+for standalone single-module use).  A consumer driving ``check`` alone
+never sees LOCKORDER findings.  The runtime twin is
+``analysis.runtime_guards.lock_order_sentinel`` — this rule sees lexical
+nesting only; the sentinel sees the dynamic graph (locks taken across call
+boundaries) and fails the suite with both stacks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext
+from smg_tpu.analysis.rules.locks_common import (
+    class_lock_attrs,
+    condition_aliases,
+    module_lock_names,
+)
+
+
+class LockOrderRule:
+    id = "LOCKORDER"
+    description = "nested lock acquisitions observed in both orders"
+
+    def __init__(self) -> None:
+        # (outer, inner) -> first-seen site (path, line, function, snippet)
+        self._pairs: dict[tuple[str, str], tuple[str, int, str, str]] = {}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_locks = module_lock_names(ctx.tree)
+        # module-level lock identity carries the FULL relpath: same-named
+        # modules in different directories are different locks
+        mod = ctx.relpath
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                attrs = class_lock_attrs(node)
+                # Condition(self._lock) IS self._lock for ordering: without
+                # the alias, lock-vs-condition nesting of the SAME lock would
+                # read as a phantom two-lock inversion (and a real inversion
+                # split across the two names would go unseen)
+                aliases = condition_aliases(node, attrs)
+                for fn in node.body:
+                    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._scan(ctx, fn, node.name, attrs, aliases,
+                                   module_locks, mod)
+        for fn in ctx.tree.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan(ctx, fn, None, {}, {}, module_locks, mod)
+        return iter(())  # all findings are emitted from finalize()
+
+    def finalize(self) -> list[Finding]:
+        """Report every unordered pair observed in BOTH orders anywhere in
+        the run (same-module or cross-module), anchored at the
+        lexicographically-first direction's site with the other site in the
+        message — one finding per inversion, deterministic anchor."""
+        out: list[Finding] = []
+        for (a, b), (path, line, func, snippet) in sorted(self._pairs.items()):
+            if (a, b) > (b, a):
+                continue  # report each unordered pair once, from (min, max)
+            rev = self._pairs.get((b, a))
+            if rev is None:
+                continue
+            rpath, rline, rfunc, _rsnip = rev
+            out.append(Finding(
+                rule=self.id, path=path, line=line, col=0,
+                message=(
+                    f"lock order inversion: {a} -> {b} here ({func}) but "
+                    f"{b} -> {a} at {rpath}:{rline} ({rfunc}) — two threads "
+                    "taking these in opposite orders deadlock; pick one "
+                    "order and enforce it at both sites"
+                ),
+                snippet=snippet,
+            ))
+        return out
+
+    # ---- per-function nesting scan ----
+
+    def _scan(
+        self, ctx: ModuleContext, fn, cls_name: str | None,
+        attrs: dict[str, str], aliases: dict[str, str],
+        module_locks: dict[str, str], mod: str,
+    ) -> None:
+        def ident(expr: ast.AST) -> str | None:
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self" and expr.attr in attrs):
+                return f"{cls_name}.{aliases.get(expr.attr, expr.attr)}"
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                return f"{mod}::{expr.id}"
+            return None
+
+        def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return  # nested defs run on their own call
+            taken: list[str] = []
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    name = ident(item.context_expr)
+                    if name is None:
+                        continue
+                    for outer in held + tuple(taken):
+                        if outer != name:
+                            pair = (outer, name)
+                            if pair not in self._pairs:
+                                self._pairs[pair] = (
+                                    ctx.relpath, node.lineno,
+                                    f"{cls_name + '.' if cls_name else ''}"
+                                    f"{fn.name}",
+                                    ctx.line_at(node.lineno),
+                                )
+                    taken.append(name)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held + tuple(taken))
+
+        for stmt in fn.body:
+            walk(stmt, ())

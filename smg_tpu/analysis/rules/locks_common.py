@@ -1,0 +1,118 @@
+"""Shared lock-construct detection for the concurrency rule families.
+
+LOCKAWAIT (lock kind vs execution domain), GUARDED (lock-discipline field
+inference), and LOCKORDER (acquisition-order inversion) all need the same
+seed facts: *which expressions construct a lock* and *which expressions
+reference one*.  Keeping the answers here means a new lock flavor (say,
+``threading.BoundedSemaphore``) teaches all three rules at once.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from smg_tpu.analysis.core import dotted_name
+
+THREAD_LOCKS = {
+    "threading.Lock", "threading.RLock", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Condition",
+}
+ASYNC_LOCKS = {
+    "asyncio.Lock", "asyncio.Semaphore", "asyncio.BoundedSemaphore",
+    "asyncio.Condition",
+}
+
+#: runtime_guards.make_lock(...) returns a (possibly sentinel-wrapped)
+#: threading lock — the analysis rules must keep seeing it as one, or
+#: adopting the runtime sentinel would silently blind the static rules
+_MAKE_LOCK_FACTORIES = {"make_lock"}
+
+
+def lock_kind(value: ast.AST) -> str | None:
+    """'thread' / 'async' when ``value`` constructs a lock, else None."""
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name in THREAD_LOCKS:
+            return "thread"
+        if name in ASYNC_LOCKS:
+            return "async"
+        if name.rpartition(".")[2] in _MAKE_LOCK_FACTORIES:
+            return "thread"
+    return None
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> dict[str, str]:
+    """``self.X = <lock>()`` assignments anywhere in the class: attr -> kind.
+    ``threading.Condition(self._lock)`` built ON another lock attr shares its
+    identity for ordering purposes but is still tracked as its own attr."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            kind = lock_kind(node.value)
+            if not kind:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out[t.attr] = kind
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            kind = lock_kind(node.value)
+            t = node.target
+            if (kind and isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name) and t.value.id == "self"):
+                out[t.attr] = kind
+    return out
+
+
+def module_lock_names(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = <lock>()`` assignments: name -> kind."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            kind = lock_kind(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = kind
+    return out
+
+
+def lock_ref(
+    expr: ast.AST, attr_kinds: dict[str, str], module_kinds: dict[str, str],
+) -> tuple[str, str] | None:
+    """(kind, display-name) when ``expr`` references a known lock:
+    ``self.X`` against the class table, bare ``NAME`` against the module
+    table."""
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and expr.attr in attr_kinds):
+        return attr_kinds[expr.attr], f"self.{expr.attr}"
+    if isinstance(expr, ast.Name) and expr.id in module_kinds:
+        return module_kinds[expr.id], expr.id
+    return None
+
+
+def condition_aliases(
+    cls: ast.ClassDef, lock_attrs: dict[str, str]
+) -> dict[str, str]:
+    """``self.A = threading.Condition(self.B)``: holding A IS holding B
+    (the Condition acquires the underlying lock), so for discipline
+    (GUARDED) and ordering (LOCKORDER) purposes A must resolve to B."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if dotted_name(call.func).rpartition(".")[2] != "Condition":
+            continue
+        if not call.args:
+            continue
+        root = call.args[0]
+        if not (isinstance(root, ast.Attribute)
+                and isinstance(root.value, ast.Name) and root.value.id == "self"
+                and root.attr in lock_attrs):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                aliases[t.attr] = root.attr
+    return aliases

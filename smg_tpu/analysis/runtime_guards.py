@@ -1,4 +1,5 @@
-"""Runtime complements to the static rules: transfer + recompile guards.
+"""Runtime complements to the static rules: transfer, recompile, and
+lock-order guards.
 
 Static analysis catches the patterns; these guards catch the *effects* on
 the real engine, wired into ``tests/test_analysis.py`` and the
@@ -15,13 +16,31 @@ the real engine, wired into ``tests/test_analysis.py`` and the
 - :class:`CompileCounter` — counts XLA backend compiles via
   ``jax.monitoring``.  After warmup, steady-state decode must compile
   nothing: a nonzero count is a retrace regression even when throughput
-  noise hides the stall.
+  noise hides the stall;
+- :func:`lock_order_sentinel` — lockdep-style dynamic lock-order tracking,
+  the runtime twin of the LOCKORDER static rule.  The static rule sees only
+  lexical nesting; the sentinel sees the real graph (an engine-lock holder
+  calling into the recorder's lock crosses a function boundary no AST walk
+  follows).  Locks created through :func:`make_lock` while the sentinel is
+  armed (the context manager, or ``SMG_LOCK_SENTINEL=1`` in the
+  environment) are wrapped in :class:`SentinelLock`; each first-depth
+  acquisition records an order edge from every lock the thread already
+  holds, with the acquiring stack captured on the edge's first observation.
+  An edge whose reverse already exists is an inversion: it is recorded with
+  BOTH stacks and, at context exit (or immediately under the env flag),
+  raises :class:`LockOrderError`.  Identity is per *lock name* (lock class,
+  lockdep-style), not per instance — the order contract "breaker before
+  worker" is a class-level rule.  Unarmed, ``make_lock`` returns the plain
+  ``threading`` primitive: zero overhead in production.
 
 jax is imported lazily so the lint-only CLI stays jax-free.
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import traceback
 from contextlib import contextmanager
 
 # every XLA backend compile records this event (jax>=0.4 monitoring)
@@ -112,3 +131,224 @@ def steady_state_guard(max_compiles: int = 0):
             f"(budget {max_compiles}): a jit signature changed per step — "
             "see the RETRACE rule docs in smg_tpu/analysis/rules/retrace.py"
         )
+
+
+# ---- lock-order sentinel (the LOCKORDER rule's runtime twin) ----
+
+#: env flag arming a process-global sentinel that raises AT THE ACQUISITION
+#: that completes an inversion — turning any test that trips one into a
+#: loud failure with both stacks, no harness changes needed
+SENTINEL_ENV = "SMG_LOCK_SENTINEL"
+
+
+class LockOrderError(RuntimeError):
+    """A lock-order inversion, reported with both acquisition stacks."""
+
+
+class LockOrderSentinel:
+    """Dynamic lock-order graph: nodes are lock NAMES, an edge A->B means
+    some thread acquired B while holding A.  The reverse edge appearing is
+    an inversion (a 2-cycle — the classic ABBA deadlock shape); it is
+    recorded with the stack that created the first edge and the stack that
+    closed the cycle.  The graph and inversion list live under a plain
+    internal lock (never a SentinelLock — the sentinel must not watch
+    itself)."""
+
+    def __init__(self, raise_on_inversion: bool = False):
+        self.raise_on_inversion = raise_on_inversion
+        self._mu = threading.Lock()
+        # (holder, acquired) -> stack captured when the edge first appeared
+        self._edges: dict[tuple[str, str], str] = {}
+        self.inversions: list[dict] = []
+        self._held = threading.local()
+
+    # ---- per-acquisition hooks (called by SentinelLock at depth 0/1) ----
+
+    def note_acquire(self, name: str) -> None:
+        held: list[str] = getattr(self._held, "names", None)
+        if held is None:
+            held = self._held.names = []
+        # racy fast-path pre-check, re-verified under self._mu below: a
+        # stale miss only costs one extra stack capture, never a lost edge
+        new_edges = [(h, name) for h in held if h != name
+                     and (h, name) not in self._edges]  # smglint: disable=GUARDED benign pre-check, rechecked under _mu
+        held.append(name)
+        if not new_edges:
+            return
+        stack = "".join(traceback.format_stack(limit=16)[:-2])
+        fresh = 0
+        with self._mu:
+            for edge in new_edges:
+                if edge in self._edges:
+                    continue
+                self._edges[edge] = stack
+                rev = self._edges.get((edge[1], edge[0]))
+                if rev is not None:
+                    fresh += 1
+                    self.inversions.append({
+                        "first": f"{edge[1]} -> {edge[0]}",
+                        "first_stack": rev,
+                        "second": f"{edge[0]} -> {edge[1]}",
+                        "second_stack": stack,
+                    })
+        if fresh and self.raise_on_inversion:
+            raise LockOrderError(self.format_inversions())
+
+    def note_release(self, name: str) -> None:
+        held = getattr(self._held, "names", None)
+        if held:
+            # remove the LAST occurrence: releases unwind LIFO, and an
+            # out-of-order release of an aliased name must not strip the
+            # wrong hold
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    break
+
+    def format_inversions(self) -> str:
+        with self._mu:
+            inversions = list(self.inversions)
+        parts = [f"{len(inversions)} lock-order inversion(s):"]
+        for inv in inversions:
+            parts.append(
+                f"\n=== {inv['second']} (conflicts with {inv['first']}) ===\n"
+                f"--- stack that established {inv['first']} ---\n"
+                f"{inv['first_stack']}"
+                f"--- stack that closed the cycle ({inv['second']}) ---\n"
+                f"{inv['second_stack']}"
+            )
+        return "".join(parts)
+
+
+class SentinelLock:
+    """Drop-in wrapper over a ``threading`` lock that reports first-depth
+    acquisitions/releases to a :class:`LockOrderSentinel`.  Re-entrant
+    acquisitions (RLock) are depth-counted and not re-reported.  Implements
+    the ``threading.Condition`` owner protocol (``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned``) so a Condition built on a
+    sentinel-wrapped (R)Lock keeps working — a ``wait()`` fully releases
+    the hold and re-registers it on wakeup."""
+
+    def __init__(self, name: str, inner, sentinel: LockOrderSentinel):
+        self._name = name
+        self._inner = inner
+        self._sentinel = sentinel
+        self._local = threading.local()
+
+    # ---- core lock protocol ----
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            depth = getattr(self._local, "depth", 0)
+            self._local.depth = depth + 1
+            if depth == 0:
+                try:
+                    self._sentinel.note_acquire(self._name)
+                except LockOrderError:
+                    # raise-on-inversion mode: leave the lock UNHELD so the
+                    # failing test's unwinding doesn't wedge other threads
+                    self._local.depth = depth
+                    self._sentinel.note_release(self._name)
+                    self._inner.release()
+                    raise
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        depth = getattr(self._local, "depth", 1) - 1
+        self._local.depth = depth
+        if depth == 0:
+            self._sentinel.note_release(self._name)
+
+    def __enter__(self) -> "SentinelLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # ---- Condition owner protocol ----
+
+    def _release_save(self):
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = 0
+        if depth:
+            self._sentinel.note_release(self._name)
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        self._local.depth = depth
+        if depth:
+            self._sentinel.note_acquire(self._name)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return getattr(self._local, "depth", 0) > 0
+
+
+_ambient_sentinel: LockOrderSentinel | None = None
+
+
+def _active_sentinel() -> LockOrderSentinel | None:
+    global _ambient_sentinel
+    if _ambient_sentinel is not None:
+        return _ambient_sentinel
+    if os.environ.get(SENTINEL_ENV, "").strip() not in ("", "0"):
+        # env-armed: one process-global sentinel, inversions raise at the
+        # offending acquisition (the test holding it fails with both stacks)
+        _ambient_sentinel = LockOrderSentinel(raise_on_inversion=True)
+        return _ambient_sentinel
+    return None
+
+
+def make_lock(name: str, *, reentrant: bool = False):
+    """The adoption point: concurrency-critical locks (engine, flight
+    recorder, breaker/worker/registry, route observability, SLO tracker)
+    are created through this instead of ``threading.Lock()`` directly.
+    Unarmed it returns the bare primitive — identical behavior, zero
+    overhead; armed it returns a :class:`SentinelLock` participating in
+    order tracking under ``name`` (the lock CLASS — instances share it)."""
+    inner = threading.RLock() if reentrant else threading.Lock()
+    sentinel = _active_sentinel()
+    if sentinel is None:
+        return inner
+    return SentinelLock(name, inner, sentinel)
+
+
+@contextmanager
+def lock_order_sentinel(*, raise_on_inversion: bool = False):
+    """Arm lock-order tracking for the block: locks created via
+    :func:`make_lock` inside it are sentinel-wrapped.  Yields the
+    :class:`LockOrderSentinel`; on exit, any recorded inversion raises
+    :class:`LockOrderError` with both acquisition stacks::
+
+        with lock_order_sentinel() as s:
+            eng = build_engine(); run_workload(eng)
+        # raises here if any two lock classes were taken in both orders
+
+    ``raise_on_inversion=True`` raises at the acquisition that closes the
+    cycle instead (pinpoints the offending call in the failing test's own
+    traceback)."""
+    global _ambient_sentinel
+    prev = _ambient_sentinel
+    sentinel = LockOrderSentinel(raise_on_inversion=raise_on_inversion)
+    _ambient_sentinel = sentinel
+    try:
+        yield sentinel
+    finally:
+        _ambient_sentinel = prev
+    if sentinel.inversions:
+        raise LockOrderError(sentinel.format_inversions())

@@ -14,6 +14,7 @@ import time
 import uuid
 from dataclasses import dataclass, field
 
+from smg_tpu.analysis.runtime_guards import make_lock
 from smg_tpu.engine.config import EngineConfig
 from smg_tpu.engine.detokenize import IncrementalDecoder, StopStringChecker
 from smg_tpu.engine.events import KvEventPublisher
@@ -120,7 +121,7 @@ class Engine:
         self._callbacks: dict[str, object] = {}
         self._json_filter = None  # shared TokenFilter (piece table + mask cache)
         self._grammar_filters: dict = {}  # (kind, pattern) -> TokenFilter
-        self._lock = threading.RLock()
+        self._lock = make_lock("engine", reentrant=True)
         self._wakeup = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
         self._stopping = False

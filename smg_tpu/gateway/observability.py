@@ -10,7 +10,6 @@ the hook points match).
 from __future__ import annotations
 
 import contextvars
-import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -23,6 +22,7 @@ from prometheus_client import (
     generate_latest,
 )
 
+from smg_tpu.analysis.runtime_guards import make_lock
 from smg_tpu.utils import get_logger, percentile
 
 logger = get_logger("gateway.observability")
@@ -249,7 +249,7 @@ class SloTracker:
     def __init__(self, metrics: "Metrics | None" = None, keep: int = 256):
         self.metrics = metrics
         self.keep = keep
-        self._lock = threading.Lock()
+        self._lock = make_lock("slo_tracker")
         self._done: deque = deque(maxlen=keep)
         self.num_requests = 0
 

@@ -31,7 +31,6 @@ routing plane accountable:
 from __future__ import annotations
 
 import itertools
-import threading
 import time
 from bisect import bisect_left
 from collections import deque
@@ -43,6 +42,7 @@ from prometheus_client.core import (
     HistogramMetricFamily,
 )
 
+from smg_tpu.analysis.runtime_guards import make_lock
 from smg_tpu.gateway.tracing import current_span
 from smg_tpu.policies.base import DECISION_SCHEMA_VERSION, RouteDecision
 from smg_tpu.utils import get_logger
@@ -216,7 +216,7 @@ class RouteObservability:
         )
         r.register(_CacheIndexCollector(self))
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("route_observability")
         self._serial = itertools.count(1)
         self._rings: dict[str, deque] = {}
         self.num_decisions = 0
@@ -262,7 +262,10 @@ class RouteObservability:
         self.num_decisions = serial  # same monotonic count, one increment
         decision.ts = time.time()
         key = decision.model_id or "__default__"
-        ring = self._rings.get(key)
+        # lock-free dict probe on purpose: this rides EVERY select_worker
+        # call inside the ≤2% overhead budget; dict.get is GIL-atomic and a
+        # miss falls through to the locked setdefault below
+        ring = self._rings.get(key)  # smglint: disable=GUARDED hot-path probe; locked setdefault on miss
         if ring is None:
             with self._lock:
                 ring = self._rings.setdefault(

@@ -9,10 +9,10 @@ Reference: ``model_gateway/src/worker/`` (SURVEY.md §2.1): ``trait Worker``
 from __future__ import annotations
 
 import enum
-import threading
 import time
 from typing import Callable
 
+from smg_tpu.analysis.runtime_guards import make_lock
 from smg_tpu.gateway.worker_client import WorkerClient
 from smg_tpu.utils import get_logger
 
@@ -64,7 +64,7 @@ class CircuitBreaker:
         # would starve real probes.  The timestamp self-heals a probe whose
         # outcome never lands (client vanished before record_*).
         self._probe_started: float | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("breaker")
 
     def _state_locked(self) -> CircuitState:
         if (
@@ -173,14 +173,17 @@ class Worker:
         self.healthy = True
         self.draining = False  # drain-before-remove: no new selections
         self._load = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("worker")
         self.registered_at = time.time()
         self.total_requests = 0
         self.total_failures = 0
 
     @property
     def load(self) -> int:
-        return self._load
+        # lock-free on purpose: routing policies read every candidate's load
+        # per decision (~µs budget), and a torn read is impossible for a
+        # GIL-atomic int — worst case the policy sees a load one request old
+        return self._load  # smglint: disable=GUARDED hot-path snapshot read; GIL-atomic int
 
     def is_available(self) -> bool:
         return self.healthy and not self.draining and self.circuit.allow()
@@ -204,6 +207,13 @@ class Worker:
             self.total_failures += 1
 
     def describe(self) -> dict:
+        # cold path (debug/admin endpoints): take the lock so the request
+        # counters come out of one consistent snapshot — GUARDED flagged the
+        # lock-free reads racing _inc/_record_failure from request threads
+        with self._lock:
+            load = self._load
+            total_requests = self.total_requests
+            total_failures = self.total_failures
         return {
             "worker_id": self.worker_id,
             "model_id": self.model_id,
@@ -212,9 +222,9 @@ class Worker:
             "healthy": self.healthy,
             "draining": self.draining,
             "circuit": self.circuit.state.value,
-            "load": self.load,
-            "total_requests": self.total_requests,
-            "total_failures": self.total_failures,
+            "load": load,
+            "total_requests": total_requests,
+            "total_failures": total_failures,
         }
 
 
@@ -257,7 +267,7 @@ class WorkerRegistry:
 
     def __init__(self):
         self._workers: dict[str, Worker] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("worker_registry")
         self._listeners: list[Callable[[str, Worker], None]] = []
         # per-REGISTRY breaker defaults (CLI --cb-*): applied as workers
         # register, so two gateways in one process keep their own settings

@@ -80,11 +80,15 @@ class PixelCache:
 
     @property
     def size_bytes(self) -> int:
-        return self._bytes
+        # metric/debug surface, not a hot path: lock so the byte count never
+        # reads mid-eviction (put() mutates _bytes several times per call)
+        with self._lock:
+            return self._bytes
 
     def stats(self) -> dict:
-        return {"hits": self.hits, "misses": self.misses,
-                "bytes": self._bytes, "items": len(self._items)}
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "bytes": self._bytes, "items": len(self._items)}
 
 
 _global: "PixelCache | None" = None

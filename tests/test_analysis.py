@@ -370,6 +370,489 @@ class TestRetrace:
         assert rules_of(lint_source(src, COLD)) == ["RETRACE"]
 
 
+# ---------------------------------------------------------------- GUARDED
+
+_GUARDED_CLASS = """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+{body}
+"""
+
+
+class TestGuarded:
+    def test_lockfree_read_of_guarded_field_fires(self):
+        src = _GUARDED_CLASS.format(body=(
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+            "    def peek(self):\n"
+            "        return self._n\n"
+        ))
+        hits = rules_of(lint_source(src, COLD), "GUARDED")
+        assert hits == ["GUARDED"]
+
+    def test_lockfree_write_fires(self):
+        src = _GUARDED_CLASS.format(body=(
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 1\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 2\n"
+            "    def c(self):\n"
+            "        self._n = 3\n"
+        ))
+        findings = [f for f in lint_source(src, COLD)
+                    if f.rule == "GUARDED" and not f.suppressed]
+        assert len(findings) == 1 and "write to self._n" in findings[0].message
+
+    def test_majority_not_met_stays_quiet(self):
+        # half the writes are lock-free: no discipline to infer
+        src = _GUARDED_CLASS.format(body=(
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 1\n"
+            "    def b(self):\n"
+            "        self._n = 2\n"
+        ))
+        assert rules_of(lint_source(src, COLD), "GUARDED") == []
+
+    def test_init_writes_do_not_count(self):
+        # the only non-init write is locked; __init__'s unlocked one is
+        # pre-publication and must not dilute the census
+        src = _GUARDED_CLASS.format(body=(
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+        ))
+        assert rules_of(lint_source(src, COLD), "GUARDED") == []
+
+    def test_locked_context_helper_clean(self):
+        # the *_locked convention: every call site holds the lock
+        src = _GUARDED_CLASS.format(body=(
+            "    def _advance_locked(self):\n"
+            "        self._n += 1\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            self._advance_locked()\n"
+            "    def tock(self):\n"
+            "        with self._lock:\n"
+            "            self._advance_locked()\n"
+            "            self._n = 5\n"
+        ))
+        assert rules_of(lint_source(src, COLD), "GUARDED") == []
+
+    def test_helper_with_unlocked_caller_fires(self):
+        src = _GUARDED_CLASS.format(body=(
+            "    def _advance(self):\n"
+            "        self._n += 1\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            self._advance()\n"
+            "            self._n = 2\n"
+            "    def tock(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 3\n"
+            "    def free(self):\n"
+            "        self._advance()\n"
+        ))
+        findings = rules_of(lint_source(src, COLD), "GUARDED")
+        assert findings == ["GUARDED"]  # the write inside _advance
+
+    def test_condition_alias_counts_as_lock(self):
+        src = (
+            "import threading\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._wakeup = threading.Condition(self._lock)\n"
+            "        self._q = 0\n"
+            "    def submit(self):\n"
+            "        with self._wakeup:\n"
+            "            self._q = 1\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            self._q = 2\n"
+        )
+        assert rules_of(lint_source(src, COLD), "GUARDED") == []
+
+    def test_container_mutation_is_a_write(self):
+        src = _GUARDED_CLASS.format(body=(
+            "    def put(self, x):\n"
+            "        with self._lock:\n"
+            "            self._n = [x]\n"
+            "    def put2(self, x):\n"
+            "        with self._lock:\n"
+            "            self._n.append(x)\n"
+            "    def leak(self, x):\n"
+            "        self._n.append(x)\n"
+        ))
+        findings = [f for f in lint_source(src, COLD)
+                    if f.rule == "GUARDED" and not f.suppressed]
+        assert len(findings) == 1 and "write to self._n" in findings[0].message
+
+    def test_cross_thread_reachability_tagged(self):
+        src = (
+            "import threading\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._p = 0.0\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            self._p = 1.0\n"
+            "    def go(self):\n"
+            "        threading.Thread(target=self._watch).start()\n"
+            "    def _watch(self):\n"
+            "        return self._p\n"
+        )
+        findings = [f for f in lint_source(src, COLD)
+                    if f.rule == "GUARDED" and not f.suppressed]
+        assert len(findings) == 1
+        assert "[cross-thread" in findings[0].message
+
+    def test_thread_entry_is_not_a_locked_context(self):
+        """A private method that is BOTH a Thread target and called in-class
+        under the lock must not be inferred lock-held — the thread invokes
+        it with nothing held (the watchdog-reads-progress-stamps race)."""
+        src = (
+            "import threading\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._p = 0\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            self._p = 1\n"
+            "    def go(self):\n"
+            "        threading.Thread(target=self._watch).start()\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            self._watch()\n"
+            "    def _watch(self):\n"
+            "        return self._p\n"
+        )
+        findings = [f for f in lint_source(src, COLD)
+                    if f.rule == "GUARDED" and not f.suppressed]
+        assert len(findings) == 1
+        assert "[cross-thread" in findings[0].message
+
+    def test_guarded_by_annotation_forces(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.flips = 0  # smglint: guarded-by(_lock)\n"
+            "    def flip(self):\n"
+            "        self.flips += 1\n"
+        )
+        findings = [f for f in lint_source(src, COLD)
+                    if f.rule == "GUARDED" and not f.suppressed]
+        assert len(findings) == 1
+        assert "guarded-by annotation" in findings[0].message
+
+    def test_suppression(self):
+        src = _GUARDED_CLASS.format(body=(
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+            "    def peek(self):\n"
+            "        return self._n  # smglint: disable=GUARDED atomic int read\n"
+        ))
+        findings = [f for f in lint_source(src, COLD) if f.rule == "GUARDED"]
+        assert findings and all(f.suppressed for f in findings)
+
+    def test_class_without_thread_lock_skipped(self):
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._n = 0\n"
+            "    def a(self):\n"
+            "        self._n += 1\n"
+        )
+        assert rules_of(lint_source(src, COLD), "GUARDED") == []
+
+
+# -------------------------------------------------------------- FRAMEFOLD
+
+FF = "smg_tpu/engine/scheduler.py"
+
+
+def _ff(findings):
+    return [f for f in findings if f.rule == "FRAMEFOLD" and not f.suppressed]
+
+
+class TestFrameFold:
+    def test_discarded_launch_fires(self):
+        src = (
+            "class S:\n"
+            "    def step(self):\n"
+            "        self._launch_frame([])\n"
+        )
+        hits = _ff(lint_source(src, FF))
+        assert len(hits) == 1 and "result discarded" in hits[0].message
+
+    def test_real_decode_batch_shape_clean(self):
+        src = (
+            "class S:\n"
+            "    def _decode_batch(self, active, outputs):\n"
+            "        frame = self._launch_frame(active)\n"
+            "        if frame is not None:\n"
+            "            try:\n"
+            "                fetch, used = self._consume_frame(frame, outputs)\n"
+            "            except Exception:\n"
+            "                self.inflight = frame\n"
+            "                raise\n"
+            "            if used < frame.horizon:\n"
+            "                self._rewind_unused_folds(frame, used)\n"
+        )
+        assert _ff(lint_source(src, FF)) == []
+
+    def test_consume_without_try_fires(self):
+        src = (
+            "class S:\n"
+            "    def step(self, active, outputs):\n"
+            "        frame = self._launch_frame(active)\n"
+            "        fetch, used = self._consume_frame(frame, outputs)\n"
+            "        if used < frame.horizon:\n"
+            "            self._rewind_unused_folds(frame, used)\n"
+        )
+        hits = _ff(lint_source(src, FF))
+        assert len(hits) == 1 and "exception-edge" in hits[0].message
+
+    def test_handler_that_rewinds_counts_as_protection(self):
+        src = (
+            "class S:\n"
+            "    def step(self, active, outputs):\n"
+            "        frame = self._launch_frame(active)\n"
+            "        try:\n"
+            "            self._consume_frame(frame, outputs)\n"
+            "        except Exception:\n"
+            "            self._discard_frame(frame)\n"
+            "            raise\n"
+            "        self._rewind_unused_folds(frame, 0)\n"
+        )
+        assert _ff(lint_source(src, FF)) == []
+
+    def test_never_resolved_frame_fires(self):
+        src = (
+            "class S:\n"
+            "    def step(self):\n"
+            "        frame = self._launch_frame([])\n"
+            "        if frame is None:\n"
+            "            return\n"
+        )
+        hits = _ff(lint_source(src, FF))
+        assert len(hits) == 1 and "never" in hits[0].message
+
+    def test_early_return_between_launch_and_resolution_fires(self):
+        src = (
+            "class S:\n"
+            "    def step(self, cond, outputs):\n"
+            "        frame = self._launch_frame([])\n"
+            "        if cond:\n"
+            "            return None\n"
+            "        try:\n"
+            "            self._consume_frame(frame, outputs)\n"
+            "        except Exception:\n"
+            "            self.inflight = frame\n"
+            "            raise\n"
+            "        self._rewind_unused_folds(frame, 0)\n"
+        )
+        hits = _ff(lint_source(src, FF))
+        assert len(hits) == 1 and "exit path leaks" in hits[0].message
+
+    def test_none_guard_return_clean(self):
+        # `if frame is None: return` — the launcher bailed pre-fold
+        src = (
+            "class S:\n"
+            "    def step(self, outputs):\n"
+            "        frame = self._launch_spec_frame([], {}, False)\n"
+            "        if frame is None:\n"
+            "            return\n"
+            "        self.inflight = frame\n"
+        )
+        assert _ff(lint_source(src, FF)) == []
+
+    def test_missing_tail_rewind_fires(self):
+        src = (
+            "class S:\n"
+            "    def step(self, outputs):\n"
+            "        frame = self._launch_frame([])\n"
+            "        try:\n"
+            "            self._consume_frame(frame, outputs)\n"
+            "        except Exception:\n"
+            "            self.inflight = frame\n"
+            "            raise\n"
+        )
+        hits = _ff(lint_source(src, FF))
+        assert len(hits) == 1 and "_rewind_unused_folds" in hits[0].message
+
+    def test_discarded_and_dead_fold_marks_fire(self):
+        src = (
+            "class R:\n"
+            "    def go(self, n):\n"
+            "        self._consume_folds(n)\n"
+            "        mark = self._consume_folds(n)\n"
+            "        return 1\n"
+        )
+        hits = _ff(lint_source(src, FF))
+        assert len(hits) == 2
+        assert any("mark discarded" in f.message for f in hits)
+        assert any("never used" in f.message for f in hits)
+
+    def test_mark_used_in_call_clean(self):
+        src = (
+            "class R:\n"
+            "    def go(self, n):\n"
+            "        mark = self._consume_folds(n)\n"
+            "        return self._run(mark)\n"
+        )
+        assert _ff(lint_source(src, FF)) == []
+
+    def test_suppression(self):
+        src = (
+            "class S:\n"
+            "    def step(self):\n"
+            "        self._launch_frame([])  # smglint: disable=FRAMEFOLD bench-only fire-and-forget\n"
+        )
+        findings = [f for f in lint_source(src, FF) if f.rule == "FRAMEFOLD"]
+        assert findings and all(f.suppressed for f in findings)
+
+
+# -------------------------------------------------------------- LOCKORDER
+
+_ORDER_SRC = """
+import threading
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+{body}
+"""
+
+
+class TestLockOrder:
+    def test_both_orders_fire_once(self):
+        src = _ORDER_SRC.format(body=(
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ))
+        hits = rules_of(lint_source(src, COLD), "LOCKORDER")
+        assert hits == ["LOCKORDER"]
+
+    def test_consistent_order_clean(self):
+        src = _ORDER_SRC.format(body=(
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        ))
+        assert rules_of(lint_source(src, COLD), "LOCKORDER") == []
+
+    def test_multi_item_with_counts_as_nesting(self):
+        src = _ORDER_SRC.format(body=(
+            "    def one(self):\n"
+            "        with self._a, self._b:\n"
+            "            pass\n"
+            "    def two(self):\n"
+            "        with self._b, self._a:\n"
+            "            pass\n"
+        ))
+        assert rules_of(lint_source(src, COLD), "LOCKORDER") == ["LOCKORDER"]
+
+    def test_condition_aliases_to_its_lock(self):
+        """`with self._lock: with self._wakeup:` and the reverse are the
+        SAME lock (Condition(self._lock) acquires it) — reentrant nesting,
+        not a two-lock inversion (the engine's _lock/_wakeup pattern)."""
+        src = (
+            "import threading\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._wakeup = threading.Condition(self._lock)\n"
+            "    def one(self):\n"
+            "        with self._lock:\n"
+            "            with self._wakeup:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._wakeup:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+        )
+        assert rules_of(lint_source(src, COLD), "LOCKORDER") == []
+
+    def test_cross_module_inversion(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m1.py").write_text(_ORDER_SRC.format(body=(
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        )))
+        (pkg / "m2.py").write_text(_ORDER_SRC.format(body=(
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        )))
+        findings = [f for f in lint_paths([pkg]) if f.rule == "LOCKORDER"]
+        assert len(findings) == 1
+        # anchored in one module, message points at the other site
+        assert findings[0].path == "pkg/m1.py"
+        assert "pkg/m2.py" in findings[0].message
+
+    def test_runs_do_not_leak_pairs(self, tmp_path):
+        """Fresh rule instances per run: module A's pairs must not combine
+        with a LATER run's module B into a phantom inversion."""
+        one = _ORDER_SRC.format(body=(
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        ))
+        two = _ORDER_SRC.format(body=(
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ))
+        assert rules_of(lint_source(one, COLD), "LOCKORDER") == []
+        assert rules_of(lint_source(two, COLD), "LOCKORDER") == []
+
+    def test_suppression_at_anchor_site(self):
+        src = _ORDER_SRC.format(body=(
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:  # smglint: disable=LOCKORDER documented order exception\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        ))
+        findings = [f for f in lint_source(src, COLD) if f.rule == "LOCKORDER"]
+        assert findings and all(f.suppressed for f in findings)
+
+
 # ------------------------------------------------- engine mechanics
 
 class TestEngineMechanics:
@@ -603,6 +1086,62 @@ class TestCli:
         # hot modules carry intentional, justified suppressions
         assert any(p.startswith("smg_tpu/engine") for p in paths)
 
+    def test_new_rule_families_in_default_set(self):
+        """GUARDED/FRAMEFOLD/LOCKORDER ship enabled — the self-lint gate
+        above runs them; this pins the registry so a refactor can't drop
+        one silently."""
+        from smg_tpu.analysis.rules import ALL_RULES
+
+        assert {"GUARDED", "FRAMEFOLD", "LOCKORDER"} <= set(ALL_RULES)
+
+    def test_sarif_format_round_trip(self, tmp_path):
+        """--format sarif: valid SARIF 2.1.0 whose results agree with the
+        json format finding-for-finding."""
+        mod = tmp_path / "smg_tpu" / "engine" / "scheduler.py"
+        mod.parent.mkdir(parents=True)
+        mod.write_text(
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 1\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 2\n"
+            "    def c(self):\n"
+            "        return self._n\n"
+            "def f(x):\n"
+            "    return x.item()\n"
+        )
+        rj = self.run_cli(str(mod), "--no-baseline", "--format", "json")
+        rs = self.run_cli(str(mod), "--no-baseline", "--format", "sarif")
+        assert rj.returncode == 1 and rs.returncode == 1
+        plain = json.loads(rj.stdout)
+        sarif = json.loads(rs.stdout)
+        assert sarif["version"] == "2.1.0"
+        run = sarif["runs"][0]
+        assert run["tool"]["driver"]["name"] == "smglint"
+        results = run["results"]
+        assert len(results) == len(plain) >= 2  # GUARDED + HOTSYNC
+        by_rule = {r["ruleId"] for r in results}
+        assert {"GUARDED", "HOTSYNC"} <= by_rule
+        # locations round-trip: same (path, line, 1-based col) per finding
+        got = {
+            (r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+             r["locations"][0]["physicalLocation"]["region"]["startLine"],
+             r["locations"][0]["physicalLocation"]["region"]["startColumn"])
+            for r in results
+        }
+        want = {(f["path"], f["line"], f["col"] + 1) for f in plain}
+        assert got == want
+        # every emitted ruleId resolves into the driver rule table
+        rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+        for r in results:
+            assert rule_ids[r["ruleIndex"]] == r["ruleId"]
+
 
 # ----------------------------------------------- runtime guards (probes)
 
@@ -688,3 +1227,165 @@ class TestRuntimeGuards:
         with pytest.raises(RuntimeError, match="compiled"):
             with steady_state_guard(max_compiles=0):
                 jax.jit(lambda a: a - 11)(jnp.arange(3))
+
+
+# ------------------------------------------- lock-order sentinel (runtime)
+
+class TestLockOrderSentinel:
+    """The LOCKORDER rule's runtime twin: lockdep-style dynamic order
+    tracking on the locks the engine/recorder/gateway create through
+    ``make_lock``."""
+
+    def test_unarmed_make_lock_is_plain(self, monkeypatch):
+        import threading
+
+        import smg_tpu.analysis.runtime_guards as rg
+        from smg_tpu.analysis.runtime_guards import make_lock
+
+        # neutralize any ambient arming (SMG_LOCK_SENTINEL-armed CI runs)
+        monkeypatch.delenv(rg.SENTINEL_ENV, raising=False)
+        monkeypatch.setattr(rg, "_ambient_sentinel", None)
+        assert isinstance(make_lock("x"), type(threading.Lock()))
+        # reentrant flavor: an RLock (acquirable twice on one thread)
+        r = make_lock("y", reentrant=True)
+        with r:
+            with r:
+                pass
+
+    def test_clean_order_passes(self):
+        from smg_tpu.analysis.runtime_guards import lock_order_sentinel, make_lock
+
+        with lock_order_sentinel() as s:
+            a, b = make_lock("a"), make_lock("b")
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        assert s.inversions == []
+
+    def test_deliberate_inversion_fails_loudly_with_both_stacks(self):
+        """THE repro the ISSUE asks for: an ABBA inversion must fail the
+        block with BOTH acquisition stacks in the error."""
+        from smg_tpu.analysis.runtime_guards import (
+            LockOrderError,
+            lock_order_sentinel,
+            make_lock,
+        )
+
+        with pytest.raises(LockOrderError) as ei:
+            with lock_order_sentinel():
+                a, b = make_lock("a"), make_lock("b")
+                with a:
+                    with b:
+                        pass
+                with b:
+                    with a:
+                        pass
+        msg = str(ei.value)
+        assert "a -> b" in msg and "b -> a" in msg
+        # both stacks present, each pointing into THIS test
+        assert msg.count("stack that") >= 2
+        assert msg.count("test_deliberate_inversion") >= 2
+
+    def test_raise_on_inversion_pinpoints_and_unwinds(self):
+        from smg_tpu.analysis.runtime_guards import (
+            LockOrderError,
+            lock_order_sentinel,
+            make_lock,
+        )
+
+        with pytest.raises(LockOrderError):
+            with lock_order_sentinel(raise_on_inversion=True) as s:
+                a, b = make_lock("a"), make_lock("b")
+                with a:
+                    with b:
+                        pass
+                with b:
+                    with a:  # raises HERE, at the closing acquisition
+                        pass
+        # the offending lock was rolled back: nothing left held
+        assert not a.locked() and not b.locked()
+        assert len(s.inversions) == 1
+
+    def test_cross_thread_inversion_detected(self):
+        import threading
+
+        from smg_tpu.analysis.runtime_guards import (
+            LockOrderError,
+            lock_order_sentinel,
+            make_lock,
+        )
+
+        with pytest.raises(LockOrderError):
+            with lock_order_sentinel():
+                a, b = make_lock("a"), make_lock("b")
+
+                def t1():
+                    with a:
+                        with b:
+                            pass
+
+                th = threading.Thread(target=t1)
+                th.start()
+                th.join()
+                with b:
+                    with a:
+                        pass
+
+    def test_reentrant_lock_not_self_edged(self):
+        from smg_tpu.analysis.runtime_guards import lock_order_sentinel, make_lock
+
+        with lock_order_sentinel() as s:
+            r = make_lock("engine", reentrant=True)
+            with r:
+                with r:  # depth 2: no self-edge, no phantom inversion
+                    pass
+        assert s.inversions == []
+
+    def test_condition_on_sentinel_rlock_works(self):
+        import threading
+        import time
+
+        from smg_tpu.analysis.runtime_guards import lock_order_sentinel, make_lock
+
+        with lock_order_sentinel() as s:
+            lock = make_lock("engine", reentrant=True)
+            cv = threading.Condition(lock)
+            got = []
+
+            def waiter():
+                with cv:
+                    cv.wait(timeout=5)
+                    got.append(1)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+            t.join(timeout=5)
+            assert got == [1]
+        assert s.inversions == []
+
+    def test_engine_workload_under_sentinel_is_inversion_free(self):
+        """The acceptance probe: a real engine boot + decode + watchdog-era
+        locks (engine RLock, flight recorder, metrics) under the sentinel
+        records ZERO order inversions."""
+        from smg_tpu.analysis.runtime_guards import lock_order_sentinel
+        from smg_tpu.protocols.sampling import SamplingParams
+
+        with lock_order_sentinel() as s:
+            eng = _tiny_engine(overlap=True)
+            done = []
+            eng.submit(
+                [7, 9, 11, 13] * 4,
+                SamplingParams(temperature=0.0, max_new_tokens=16,
+                               ignore_eos=True),
+                rid="sentinel-probe",
+                on_output=lambda o: done.append(o),
+            )
+            while eng.scheduler.has_work():
+                eng.step()
+            eng.stop(drain=True, timeout=5.0)
+            assert sum(len(o.new_token_ids) for o in done) == 16
+        assert s.inversions == []

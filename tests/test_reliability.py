@@ -882,3 +882,39 @@ def test_first_chunk_timeout_separate_from_idle_bound():
     call, got = asyncio.run(go())
     assert got == ["first", "second"]  # slow start survived the idle bound
     assert call.cancelled              # mid-stream silence did not
+
+
+def test_reliability_locks_inversion_free_under_sentinel():
+    """Lock-order sentinel over the failure-isolation hot paths: engine
+    RLock + flight-recorder lock + quarantine dump path, exercised by a
+    poison-decode quarantine with survivors, record ZERO order inversions.
+    (scripts/ci_checks.sh additionally runs this whole suite with
+    SMG_LOCK_SENTINEL=1, which fails any test at the acquisition closing an
+    inversion cycle.)"""
+    from smg_tpu.analysis.runtime_guards import lock_order_sentinel
+
+    with lock_order_sentinel() as s:
+        eng = make_engine()  # locks created inside the armed block
+        outs: dict = {}
+        rids = []
+        for i in range(3):
+            rid = f"sent-{i}"
+            rids.append(rid)
+            eng.submit(
+                [(5 * i + j) % 90 + 5 for j in range(16)],
+                SamplingParams(temperature=0.0, max_new_tokens=12,
+                               ignore_eos=True),
+                rid=rid, on_output=_collector(outs, rid),
+            )
+        # poison one decode step mid-flight: quarantine + flight-recorder
+        # dump runs with the engine lock held (the nesting under test)
+        FAULTS.arm("engine.decode_step", mode="once")
+        _drive(eng, outs, rids)
+        quarantined = [
+            r for r in rids
+            if any(o.finish_reason == "error" for o in outs[r])
+        ]
+        assert len(quarantined) == 1  # blame fell on exactly one lane
+        eng.stop(drain=True, timeout=5.0)
+        assert_engine_clean(eng)
+    assert s.inversions == [], s.format_inversions()
