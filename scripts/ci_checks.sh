@@ -51,7 +51,19 @@
 #                       rejection exactness, quarantine rewind of an
 #                       in-flight spec frame, 0-recompile steady state with
 #                       spec on, tier/flag plumbing
-#                       (tests/test_speculative.py).
+#                       (tests/test_speculative.py);
+#   9. SLO enforcement + loadgen — burn-rate window math, verdict
+#                       hysteresis, the SLO-record disconnect-termination
+#                       regression, Engine.audit zero-leak surface
+#                       (tests/test_slo_enforcement.py), then the SEEDED
+#                       loadgen smoke end-to-end (gateway + 2 in-proc
+#                       workers, mixed matrix incl. disconnects and
+#                       deadline'd requests, ~30s budget with a warm XLA
+#                       cache): exits nonzero on ANY SLO-verdict violation,
+#                       429-with-breaker-penalty, dropped stream under
+#                       drain, missing violation-window flight dump, or
+#                       nonzero leak audit at quiescence
+#                       (benches/loadgen.py --seed 0 --workers 2).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -90,5 +102,10 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_route_observability.py \
 echo "== speculative decoding (fused draft-verify) parity =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q \
     -m 'not slow' -p no:cacheprovider
+
+echo "== SLO enforcement + seeded loadgen smoke =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_slo_enforcement.py -q \
+    -m 'not slow' -p no:cacheprovider
+JAX_PLATFORMS=cpu python benches/loadgen.py --seed 0 --workers 2
 
 echo "ci_checks: all green"

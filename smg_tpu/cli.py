@@ -115,6 +115,15 @@ def _add_gateway_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--mcp-config-path", default=None, dest="mcp_config_path",
                    help="JSON file of MCP servers: "
                         '[{"name": ..., "url": ..., "headers": {...}}]')
+    g.add_argument("--slo-spec", default=None, dest="slo_spec",
+                   help="JSON file of declarative SLO specs (a list of "
+                        "objects or {'slos': [...]}; fields: name, "
+                        "ttft_p95_s, itl_p95_s, e2e_p95_s, "
+                        "goodput_ratio_floor, deadline_miss_budget, "
+                        "fast/slow_window_s, fast/slow_burn, min_requests, "
+                        "hysteresis).  Verdicts at GET /debug/slo/verdicts; "
+                        "violations and burn rate exported as "
+                        "smg_slo_violations_total / smg_slo_burn_rate")
 
     pol = p.add_argument_group("Routing policy")
     pol.add_argument("--cache-threshold", type=float, default=0.5,

@@ -284,12 +284,40 @@ class Engine:
             < self.config.max_consecutive_step_failures
         )
 
-    def loads(self) -> dict:
+    def loads(self, include_audit: bool = True) -> dict:
+        """Engine load/stat snapshot.  ``include_audit=False`` is for hot
+        per-dispatch callers (the DP-replica pick) that only want the cheap
+        counters — the audit's radix-tree lock walk is ops-plane cost."""
         with self._lock:
             out = self.scheduler.loads()
+            if include_audit:
+                # zero-leak quiescence audit: operators (and the loadgen
+                # harness) assert steady-state cleanliness from loads() /
+                # /scheduler without reaching into scheduler internals
+                out["audit"] = self._audit_locked()
         out["healthy"] = self.healthy
         out["watchdog_stalls"] = self.num_watchdog_stalls
         return out
+
+    def _audit_locked(self) -> dict:
+        """``Scheduler.audit`` + the one leak class only the engine sees
+        (output callbacks).  Caller holds the engine lock."""
+        out = self.scheduler.audit()
+        pending = len(self._callbacks)
+        out["pending_callbacks"] = pending
+        out["clean"] = out["clean"] and (not out["quiescent"] or pending == 0)
+        return out
+
+    def audit(self) -> dict:
+        """Zero-leak quiescence audit (``Scheduler.audit`` + engine-level
+        callback accounting).  The contract the loadgen harness asserts at
+        steady state: ``clean`` is True, meaning every KV page is free,
+        radix-cached, or held by a live lane; radix lock refcounts and
+        output callbacks are all owned by live requests; and no in-flight
+        overlap frame is stranded.  Also rides ``loads()["audit"]`` (and
+        thus ``/scheduler``) so operators get the same verdict remotely."""
+        with self._lock:
+            return self._audit_locked()
 
     def dump_flight(self, reason: str = "manual") -> dict:
         """Flight-recorder snapshot: the per-step ring, per-request

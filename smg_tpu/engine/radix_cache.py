@@ -228,3 +228,19 @@ class RadixCache:
 
     def stats(self) -> dict:
         return {"cached_pages": self._size, "evicted_pages": self.evicted_pages}
+
+    def lock_stats(self) -> dict:
+        """Pin accounting for the zero-leak quiescence audit
+        (``Scheduler.audit``): how many nodes are refcount-pinned and the
+        total refcount across them.  Every pin belongs to a live request's
+        ``radix_node`` lock — at quiescence both numbers must be zero, or a
+        release path leaked a ``lock`` without its ``unlock``.  O(tree
+        nodes): ops-plane (``loads()`` / ``/scheduler``), not the step loop.
+        """
+        locked_nodes = 0
+        lock_refcounts = 0
+        for node in self._iter_nodes():
+            if node.refcount:
+                locked_nodes += 1
+                lock_refcounts += node.refcount
+        return {"locked_nodes": locked_nodes, "lock_refcounts": lock_refcounts}

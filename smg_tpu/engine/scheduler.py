@@ -400,6 +400,59 @@ class Scheduler:
             out["stats"] = self.metrics.window.snapshot()
         return out
 
+    def audit(self) -> dict:
+        """Zero-leak resource audit over the page pool, radix cache, slots,
+        and the overlap frame (the ``loads()["audit"]`` payload).
+
+        Invariants it makes assertable:
+
+        - ``leaked_pages == 0`` ALWAYS: every allocatable page is free,
+          radix-cached, or owned by a slot-resident request (waiting and
+          preempted requests hold no pages; PD export/import hold them only
+          within a single engine-locked call, which this — also
+          engine-locked — can never observe mid-flight);
+        - at quiescence (no slots, no queue, no in-flight frame) the radix
+          lock refcounts are zero and no output callbacks linger (checked at
+          the engine layer) — a nonzero here is a leaked ``lock``/callback
+          from some release path.
+
+        O(slots + tree nodes): ops-plane cost, never paid by the step loop.
+        """
+        live = [r for r in self.slots if r is not None]
+        held_pages = sum(len(r.owned_pages) for r in live)
+        pinned_shared = sum(len(r.shared_pages) for r in live)
+        cached = self.radix.num_cached_pages if self.radix else 0
+        allocatable = self.pool.num_pages - 1  # page 0 = reserved garbage
+        free = self.pool.free_count
+        locks = (
+            self.radix.lock_stats() if self.radix is not None
+            else {"locked_nodes": 0, "lock_refcounts": 0}
+        )
+        quiescent = (
+            not live and not self.waiting and self.inflight is None
+        )
+        leaked = allocatable - free - cached - held_pages
+        return {
+            "live_slots": len(live),
+            "waiting_requests": len(self.waiting),
+            "inflight_frames": 0 if self.inflight is None else 1,
+            "held_pages": held_pages,
+            "pinned_shared_pages": pinned_shared,
+            "free_pages": free,
+            "radix_cached_pages": cached,
+            "allocatable_pages": allocatable,
+            "leaked_pages": leaked,
+            "radix_locked_nodes": locks["locked_nodes"],
+            "radix_lock_refcounts": locks["lock_refcounts"],
+            "quiescent": quiescent,
+            # the one-bit verdict the harness asserts: no page unaccounted
+            # for now, and no stray pins once nothing is running
+            "clean": leaked == 0 and (
+                not quiescent
+                or (locks["locked_nodes"] == 0 and locks["lock_refcounts"] == 0)
+            ),
+        }
+
     def flush_cache(self) -> bool:
         """Drop the prefix cache (only when idle, like the reference engines)."""
         if any(s is not None for s in self.slots) or self.waiting:

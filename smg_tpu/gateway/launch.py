@@ -256,6 +256,15 @@ async def _run_gateway(args) -> int:
         getattr(args, "cb_success_threshold", 2),
         getattr(args, "cb_timeout_duration_secs", 30.0),
     )
+    slo_specs = None
+    if getattr(args, "slo_spec", None):
+        from smg_tpu.gateway.slo_enforcement import load_slo_specs
+
+        # file read off the serving loop, like --mcp-config-path below; a
+        # malformed spec must fail startup loudly, not at first evaluation
+        raw_slo = await asyncio.to_thread(load_slo_specs, args.slo_spec)
+        slo_specs = raw_slo
+        logger.info("SLO enforcement on: %s", [s.name for s in slo_specs])
     ctx = AppContext(
         policy=args.policy,
         router_config=router_config,
@@ -277,6 +286,7 @@ async def _run_gateway(args) -> int:
         request_timeout_secs=getattr(args, "request_timeout_secs", None),
         cors_allowed_origins=list(getattr(args, "cors_allowed_origins", []) or []),
         circuit_breaker_config=cb_config,
+        slo_specs=slo_specs,
     )
     if getattr(args, "mcp_config_path", None):
         import json as _json

@@ -110,9 +110,31 @@ class Metrics:
             "of this vs smg_generated_tokens_total",
             registry=r,
         )
+        # ---- SLO enforcement (gateway/slo_enforcement.py): declarative
+        # specs judged over the SloTracker ring; verdicts behind
+        # GET /debug/slo/verdicts ----
+        self.slo_violations = Counter(
+            "smg_slo_violations_total",
+            "SLO evaluation-window violation onsets (edge-triggered per "
+            "window: a not-violating -> violating transition counts once, "
+            "re-evaluating a still-violating window does not)",
+            ["slo", "window"], registry=r,
+        )
+        self.slo_burn_rate = Gauge(
+            "smg_slo_burn_rate",
+            "Worst current error-budget burn rate across the SLO's "
+            "fast/slow windows (deadline-miss fraction / budget; >= 1 "
+            "means the budget is being consumed faster than allowed)",
+            ["slo"], registry=r,
+        )
         #: per-request SLO timeline accounting behind the three families
         #: above, plus the /debug/slo rolling summary with trace-id exemplars
         self.slo = SloTracker(self)
+        #: SLO verdict engine over the tracker ring (specs installed via
+        #: --slo-spec / AppContext(slo_specs=...); empty = nothing enforced)
+        from smg_tpu.gateway.slo_enforcement import SloEnforcer
+
+        self.slo_enforcer = SloEnforcer(self)
         #: routing-plane observability: per-model decision rings behind
         #: /debug/router, predicted-vs-actual prefix-hit reconciliation,
         #: cache-index gauges, KvEventMonitor health families
@@ -240,6 +262,42 @@ class SloRequest:
         self._tracker._complete(self, reason, error, voluntary)
 
 
+def aggregate_slo_records(records: "list[dict]") -> dict:
+    """THE aggregation over completed-request records — the single
+    definition of the PR 6 conventions: nearest-rank percentiles over
+    per-request values, VOLUNTARY endings (client disconnects) excluded
+    from deadline accounting, goodput = deadline-met token share
+    (vacuously 1.0 over zero tokens).  Percentiles are ``None`` over empty
+    sample sets.  Shared by ``SloTracker.summary`` (``/debug/slo``) and the
+    SLO enforcer's window stats (``/debug/slo/verdicts``,
+    ``gateway/slo_enforcement.py``) so the two surfaces cannot diverge."""
+    ttfts = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
+    itls = [r["itl_mean_s"] for r in records if r["itl_mean_s"] is not None]
+    e2es = [r["e2e_s"] for r in records]
+    with_deadline = [
+        r for r in records
+        if r["deadline_s"] is not None and not r["voluntary"]
+    ]
+    missed = sum(1 for r in with_deadline if not r["deadline_met"])
+    good_tokens = sum(r["output_tokens"] for r in records if r["deadline_met"])
+    all_tokens = sum(r["output_tokens"] for r in records)
+    return {
+        "requests": len(records),
+        "with_deadline": len(with_deadline),
+        "deadline_missed": missed,
+        "miss_fraction": (missed / len(with_deadline)) if with_deadline else 0.0,
+        "ttft_p50_s": percentile(ttfts, 50) if ttfts else None,
+        "ttft_p95_s": percentile(ttfts, 95) if ttfts else None,
+        "itl_p50_s": percentile(itls, 50) if itls else None,
+        "itl_p95_s": percentile(itls, 95) if itls else None,
+        "e2e_p50_s": percentile(e2es, 50) if e2es else None,
+        "e2e_p95_s": percentile(e2es, 95) if e2es else None,
+        "goodput_tokens": good_tokens,
+        "total_tokens": all_tokens,
+        "goodput_ratio": (good_tokens / all_tokens) if all_tokens else 1.0,
+    }
+
+
 class SloTracker:
     """Bounded completed-request ring + rolling aggregates for /debug/slo.
 
@@ -305,24 +363,27 @@ class SloTracker:
             self.num_requests += 1
             self._done.append(record)
 
+    def window_records(self, window_secs: float,
+                       now: float | None = None) -> list[dict]:
+        """Completed-request records whose finish fell inside the trailing
+        ``window_secs`` (perf_counter clock, same as the records' ``t_end``).
+        The ring bounds this at ``keep`` records — a window older than the
+        ring's tail sees only what the ring still holds (size the ring, not
+        the window, for long-horizon SLOs)."""
+        cutoff = (time.perf_counter() if now is None else now) - window_secs
+        with self._lock:
+            return [r for r in self._done if r["t_end"] >= cutoff]
+
     def summary(self, recent: int = 32) -> dict:
         """Rolling SLO summary over the completed-request ring (the
         /debug/slo payload).  Percentiles are over per-request values; ITL
-        is the per-request mean gap.  Goodput rate spans the ring window."""
+        is the per-request mean gap.  Goodput rate spans the ring window.
+        Aggregation semantics live in ``aggregate_slo_records`` (shared
+        with the SLO enforcer — the two surfaces report one truth)."""
         with self._lock:
             records = list(self._done)
             total = self.num_requests
-        ttfts = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
-        itls = [r["itl_mean_s"] for r in records if r["itl_mean_s"] is not None]
-        e2es = [r["e2e_s"] for r in records]
-        with_deadline = [
-            r for r in records
-            if r["deadline_s"] is not None and not r["voluntary"]
-        ]
-        good_tokens = sum(
-            r["output_tokens"] for r in records if r["deadline_met"]
-        )
-        all_tokens = sum(r["output_tokens"] for r in records)
+        agg = aggregate_slo_records(records)
         span = (
             max(r["t_end"] for r in records)
             - min(r["t_end"] - r["e2e_s"] for r in records)
@@ -331,30 +392,34 @@ class SloTracker:
         reasons: dict[str, int] = {}
         for r in records:
             reasons[r["reason"]] = reasons.get(r["reason"], 0) + 1
+
+        def z(v):  # this payload historically reports 0.0 over empty samples
+            return 0.0 if v is None else v
+
         return {
-            "window_requests": len(records),
+            "window_requests": agg["requests"],
             "total_requests": total,
             "finish_reasons": reasons,
-            "ttft": {"p50_s": percentile(ttfts, 50),
-                     "p95_s": percentile(ttfts, 95)},
-            "itl": {"p50_s": percentile(itls, 50),
-                    "p95_s": percentile(itls, 95)},
-            "e2e": {"p50_s": percentile(e2es, 50),
-                    "p95_s": percentile(e2es, 95)},
+            "ttft": {"p50_s": z(agg["ttft_p50_s"]),
+                     "p95_s": z(agg["ttft_p95_s"])},
+            "itl": {"p50_s": z(agg["itl_p50_s"]),
+                    "p95_s": z(agg["itl_p95_s"])},
+            "e2e": {"p50_s": z(agg["e2e_p50_s"]),
+                    "p95_s": z(agg["e2e_p95_s"])},
             "deadline": {
-                "with_deadline": len(with_deadline),
-                "met": sum(1 for r in with_deadline if r["deadline_met"]),
-                "missed": sum(
-                    1 for r in with_deadline if not r["deadline_met"]
-                ),
+                "with_deadline": agg["with_deadline"],
+                "met": agg["with_deadline"] - agg["deadline_missed"],
+                "missed": agg["deadline_missed"],
             },
             "goodput": {
-                "tokens": good_tokens,
-                "total_tokens": all_tokens,
-                "tokens_per_s": (good_tokens / span) if span > 1e-9 else 0.0,
-                "ratio": (good_tokens / all_tokens) if all_tokens else 1.0,
+                "tokens": agg["goodput_tokens"],
+                "total_tokens": agg["total_tokens"],
+                "tokens_per_s": (
+                    agg["goodput_tokens"] / span if span > 1e-9 else 0.0
+                ),
+                "ratio": agg["goodput_ratio"],
             },
             # trace-id exemplars: each row links to its OTel trace and (via
             # the propagated traceparent) its worker flight timeline
-            "recent": records[-recent:],
+            "recent": records[-recent:] if recent > 0 else [],
         }

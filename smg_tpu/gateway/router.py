@@ -369,189 +369,203 @@ class Router:
             if self.config.request_timeout_secs
             else None
         )
-        while True:
-            try:
-                worker, decision = self._select_with_decision(ctx, exclude=exclude)
-            except RouteError:
-                if srec is not None:
-                    srec.fail("rate_limited" if saw_queue_full else "error")
-                if saw_queue_full:
-                    # every candidate rejected with backpressure: the honest
-                    # front-door answer is 429 retry-later, not a 5xx
-                    raise RouteError(
-                        429, "all workers at capacity; retry later",
-                        "rate_limit_error",
-                    ) from None
-                raise
-            guard = worker.acquire()
-            got_first_chunk = False
-            finished_cleanly = False
-            dp_rank = self.dp_policy.select_dp_rank(worker, dp_cost)
-            # engine-stage child spans under the request's SERVER span
-            # (gateway/tracing.py): prefill = dispatch -> first chunk,
-            # decode = first chunk -> finish; None (zero-cost) without a
-            # configured tracer
-            prefill_span = start_stage(
-                "engine.prefill", worker_id=worker.worker_id, rid=rid,
-                prompt_tokens=len(input_ids),
-            )
-            decode_span = None
-            detok_busy_ns = 0
-            last_output_tokens = 0
-
-            def _close_spans(error: bool) -> None:
-                nonlocal prefill_span, decode_span
-                end_stage(prefill_span, error=error)
-                end_stage(decode_span, error=error,
-                          output_tokens=last_output_tokens)
-                if not error and decode_span is not None and detok_busy_ns:
-                    # synthetic busy-width span: detokenize work is smeared
-                    # across chunks, so report its cumulative cost as one
-                    # trailing stage span
-                    dspan = start_stage("engine.detokenize", rid=rid)
-                    if dspan is not None:
-                        dspan.start_ns = time.time_ns() - detok_busy_ns
-                        end_stage(dspan, busy_ns=detok_busy_ns)
-                prefill_span = decode_span = None
-
-            try:
-                wreq = WorkerGenerateRequest(
-                    rid=rid, input_ids=input_ids, sampling=worker_sampling,
-                    data_parallel_rank=-1 if dp_rank is None else dp_rank,
-                    mm_embeds=mm,
-                    timeout_secs=(
-                        max(budget_deadline - time.monotonic(), 0.0)
-                        if budget_deadline is not None
-                        else None
-                    ),
+        try:
+            while True:
+                try:
+                    worker, decision = self._select_with_decision(ctx, exclude=exclude)
+                except RouteError:
+                    if srec is not None:
+                        srec.fail("rate_limited" if saw_queue_full else "error")
+                    if saw_queue_full:
+                        # every candidate rejected with backpressure: the honest
+                        # front-door answer is 429 retry-later, not a 5xx
+                        raise RouteError(
+                            429, "all workers at capacity; retry later",
+                            "rate_limit_error",
+                        ) from None
+                    raise
+                guard = worker.acquire()
+                got_first_chunk = False
+                finished_cleanly = False
+                dp_rank = self.dp_policy.select_dp_rank(worker, dp_cost)
+                # engine-stage child spans under the request's SERVER span
+                # (gateway/tracing.py): prefill = dispatch -> first chunk,
+                # decode = first chunk -> finish; None (zero-cost) without a
+                # configured tracer
+                prefill_span = start_stage(
+                    "engine.prefill", worker_id=worker.worker_id, rid=rid,
+                    prompt_tokens=len(input_ids),
                 )
-                async for chunk in worker.client.generate(wreq):
-                    if not got_first_chunk and prefill_span is not None:
-                        end_stage(prefill_span, cached_tokens=chunk.cached_tokens)
-                        prefill_span = None
-                        decode_span = start_stage(
-                            "engine.decode", worker_id=worker.worker_id, rid=rid,
-                        )
-                    if not got_first_chunk and self.metrics is not None:
-                        self.metrics.ttft.labels(route=current_route.get()).observe(
-                            time.perf_counter() - t_dispatch
-                        )
-                        self.metrics.prompt_tokens.inc(chunk.prompt_tokens)
-                        if chunk.cached_tokens:
-                            self.metrics.cached_tokens.inc(chunk.cached_tokens)
-                        if srec is not None:
-                            srec.first_token(chunk.prompt_tokens,
-                                             chunk.cached_tokens)
-                        # predicted-vs-actual prefix-hit reconciliation: the
-                        # engine's admission-time cached_tokens rides the
-                        # first chunk — fold it back into the decision ring
-                        self.metrics.route.reconcile(
-                            decision, worker.worker_id, chunk.cached_tokens
-                        )
-                    if self.metrics is not None and chunk.output_tokens > last_output_tokens:
-                        self.metrics.generated_tokens.inc(
-                            chunk.output_tokens - last_output_tokens
-                        )
-                        if srec is not None:
-                            srec.tokens(chunk.output_tokens - last_output_tokens)
-                    got_first_chunk = True
-                    last_output_tokens = chunk.output_tokens
-                    if decode_span is not None:
-                        _dt0 = time.perf_counter_ns()
-                        ev = self._chunk_to_event(chunk, detok, stop_checker)
-                        detok_busy_ns += time.perf_counter_ns() - _dt0
-                    else:
-                        ev = self._chunk_to_event(chunk, detok, stop_checker)
-                    if ev is not None:
-                        if srec is not None and ev.finished:
-                            # terminal SLO record BEFORE the yield: a consumer
-                            # that stops iterating at the final event closes
-                            # this generator at the yield point
-                            srec.finish(ev.finish_reason)
-                        yield ev
-                        if ev.finished and not chunk.finished:
-                            # gateway-side stop: cancel the worker stream
-                            await worker.client.abort(rid)
+                decode_span = None
+                detok_busy_ns = 0
+                last_output_tokens = 0
+
+                def _close_spans(error: bool) -> None:
+                    nonlocal prefill_span, decode_span
+                    end_stage(prefill_span, error=error)
+                    end_stage(decode_span, error=error,
+                              output_tokens=last_output_tokens)
+                    if not error and decode_span is not None and detok_busy_ns:
+                        # synthetic busy-width span: detokenize work is smeared
+                        # across chunks, so report its cumulative cost as one
+                        # trailing stage span
+                        dspan = start_stage("engine.detokenize", rid=rid)
+                        if dspan is not None:
+                            dspan.start_ns = time.time_ns() - detok_busy_ns
+                            end_stage(dspan, busy_ns=detok_busy_ns)
+                    prefill_span = decode_span = None
+
+                try:
+                    wreq = WorkerGenerateRequest(
+                        rid=rid, input_ids=input_ids, sampling=worker_sampling,
+                        data_parallel_rank=-1 if dp_rank is None else dp_rank,
+                        mm_embeds=mm,
+                        timeout_secs=(
+                            max(budget_deadline - time.monotonic(), 0.0)
+                            if budget_deadline is not None
+                            else None
+                        ),
+                    )
+                    async for chunk in worker.client.generate(wreq):
+                        if not got_first_chunk and prefill_span is not None:
+                            end_stage(prefill_span, cached_tokens=chunk.cached_tokens)
+                            prefill_span = None
+                            decode_span = start_stage(
+                                "engine.decode", worker_id=worker.worker_id, rid=rid,
+                            )
+                        if not got_first_chunk and self.metrics is not None:
+                            self.metrics.ttft.labels(route=current_route.get()).observe(
+                                time.perf_counter() - t_dispatch
+                            )
+                            self.metrics.prompt_tokens.inc(chunk.prompt_tokens)
+                            if chunk.cached_tokens:
+                                self.metrics.cached_tokens.inc(chunk.cached_tokens)
+                            if srec is not None:
+                                srec.first_token(chunk.prompt_tokens,
+                                                 chunk.cached_tokens)
+                            # predicted-vs-actual prefix-hit reconciliation: the
+                            # engine's admission-time cached_tokens rides the
+                            # first chunk — fold it back into the decision ring
+                            self.metrics.route.reconcile(
+                                decision, worker.worker_id, chunk.cached_tokens
+                            )
+                        if self.metrics is not None and chunk.output_tokens > last_output_tokens:
+                            self.metrics.generated_tokens.inc(
+                                chunk.output_tokens - last_output_tokens
+                            )
+                            if srec is not None:
+                                srec.tokens(chunk.output_tokens - last_output_tokens)
+                        got_first_chunk = True
+                        last_output_tokens = chunk.output_tokens
+                        if decode_span is not None:
+                            _dt0 = time.perf_counter_ns()
+                            ev = self._chunk_to_event(chunk, detok, stop_checker)
+                            detok_busy_ns += time.perf_counter_ns() - _dt0
+                        else:
+                            ev = self._chunk_to_event(chunk, detok, stop_checker)
+                        if ev is not None:
+                            if srec is not None and ev.finished:
+                                # terminal SLO record BEFORE the yield: a consumer
+                                # that stops iterating at the final event closes
+                                # this generator at the yield point
+                                srec.finish(ev.finish_reason)
+                            yield ev
+                            if ev.finished and not chunk.finished:
+                                # gateway-side stop: cancel the worker stream
+                                await worker.client.abort(rid)
+                                finished_cleanly = True
+                                guard.release(success=True)
+                                return
+                        if chunk.finished:
+                            if srec is not None:
+                                srec.finish(chunk.finish_reason)  # no-op if done
                             finished_cleanly = True
                             guard.release(success=True)
                             return
-                    if chunk.finished:
-                        if srec is not None:
-                            srec.finish(chunk.finish_reason)  # no-op if done
-                        finished_cleanly = True
-                        guard.release(success=True)
-                        return
-                # stream ended without a finish marker
-                raise RuntimeError("worker stream ended unexpectedly")
-            except RouteError:
-                guard.release(success=False)
-                if srec is not None:
-                    srec.fail("error")
-                raise
-            except (GeneratorExit, asyncio.CancelledError):
-                # client disconnected / stream task cancelled: not a worker
-                # failure — release the load guard and stop the generation
-                guard.release(success=True)
-                if srec is not None:
-                    srec.abandon("abort")
-                try:
-                    await asyncio.shield(worker.client.abort(rid))
-                except Exception:
-                    pass
-                raise
-            except WorkerQueueFullError as e:
-                # admission backpressure: retry another worker WITHOUT
-                # penalizing this one's breaker (a full queue is load, not
-                # fault — opening the circuit would shrink capacity exactly
-                # when it is most needed)
-                guard.release(success=None)
-                saw_queue_full = True
-                attempts += 1
-                exclude.add(worker.worker_id)
-                if attempts > max(self.config.max_retries, 1):
-                    if srec is not None:
-                        srec.fail("rate_limited")
-                    raise RouteError(
-                        429, "all workers at capacity; retry later",
-                        "rate_limit_error",
-                    )
-                if self.metrics is not None:
-                    self.metrics.retries_total.inc()
-                logger.warning(
-                    "worker %s rejected %s with queue-full; trying another",
-                    worker.worker_id, rid,
-                )
-                _close_spans(error=True)
-            except Exception as e:
-                guard.release(success=False)
-                attempts += 1
-                exclude.add(worker.worker_id)
-                if got_first_chunk or attempts >= self.config.max_retries:
-                    logger.exception("request %s failed on %s", rid, worker.worker_id)
+                    # stream ended without a finish marker
+                    raise RuntimeError("worker stream ended unexpectedly")
+                except RouteError:
+                    guard.release(success=False)
                     if srec is not None:
                         srec.fail("error")
-                    raise RouteError(502, f"worker error: {e}", "worker_error")
-                if self.metrics is not None:
-                    self.metrics.retries_total.inc()
-                backoff = min(
-                    self.config.retry_backoff_base * (2 ** (attempts - 1)),
-                    self.config.retry_backoff_max,
-                )
-                logger.warning(
-                    "retrying %s after failure on %s (attempt %d): %s",
-                    rid, worker.worker_id, attempts, e,
-                )
-                # close the failed attempt's spans BEFORE the backoff sleep
-                # so their duration is the real attempt, not attempt + idle
-                # (idempotent: the finally-side call then no-ops)
-                _close_spans(error=True)
-                await asyncio.sleep(backoff)
-            finally:
-                _close_spans(error=not finished_cleanly)
-                if dp_rank is not None:
-                    self.dp_policy.release(worker, dp_rank, dp_cost)
-                if not finished_cleanly:
-                    guard.release(success=True)  # no-op if already released
+                    raise
+                except (GeneratorExit, asyncio.CancelledError):
+                    # client disconnected / stream task cancelled: not a worker
+                    # failure — release the load guard and stop the generation
+                    guard.release(success=True)
+                    if srec is not None:
+                        srec.abandon("abort")
+                    try:
+                        await asyncio.shield(worker.client.abort(rid))
+                    except Exception:
+                        pass
+                    raise
+                except WorkerQueueFullError as e:
+                    # admission backpressure: retry another worker WITHOUT
+                    # penalizing this one's breaker (a full queue is load, not
+                    # fault — opening the circuit would shrink capacity exactly
+                    # when it is most needed)
+                    guard.release(success=None)
+                    saw_queue_full = True
+                    attempts += 1
+                    exclude.add(worker.worker_id)
+                    if attempts > max(self.config.max_retries, 1):
+                        if srec is not None:
+                            srec.fail("rate_limited")
+                        raise RouteError(
+                            429, "all workers at capacity; retry later",
+                            "rate_limit_error",
+                        )
+                    if self.metrics is not None:
+                        self.metrics.retries_total.inc()
+                    logger.warning(
+                        "worker %s rejected %s with queue-full; trying another",
+                        worker.worker_id, rid,
+                    )
+                    _close_spans(error=True)
+                except Exception as e:
+                    guard.release(success=False)
+                    attempts += 1
+                    exclude.add(worker.worker_id)
+                    if got_first_chunk or attempts >= self.config.max_retries:
+                        logger.exception("request %s failed on %s", rid, worker.worker_id)
+                        if srec is not None:
+                            srec.fail("error")
+                        raise RouteError(502, f"worker error: {e}", "worker_error")
+                    if self.metrics is not None:
+                        self.metrics.retries_total.inc()
+                    backoff = min(
+                        self.config.retry_backoff_base * (2 ** (attempts - 1)),
+                        self.config.retry_backoff_max,
+                    )
+                    logger.warning(
+                        "retrying %s after failure on %s (attempt %d): %s",
+                        rid, worker.worker_id, attempts, e,
+                    )
+                    # close the failed attempt's spans BEFORE the backoff sleep
+                    # so their duration is the real attempt, not attempt + idle
+                    # (idempotent: the finally-side call then no-ops)
+                    _close_spans(error=True)
+                    await asyncio.sleep(backoff)
+                finally:
+                    _close_spans(error=not finished_cleanly)
+                    if dp_rank is not None:
+                        self.dp_policy.release(worker, dp_rank, dp_cost)
+                    if not finished_cleanly:
+                        guard.release(success=True)  # no-op if already released
+        finally:
+            # termination backstop (SLO record lifecycle): a client
+            # disconnect can cancel this generator at seams the loop's
+            # own handlers never see -- e.g. between a queue-full
+            # failover and the next dispatch, or inside the retry
+            # backoff sleep (CancelledError raised in an except block
+            # bypasses the sibling handlers).  Every deliberate exit
+            # already made its terminal call (finish/fail are
+            # idempotent-first), so this records ONLY otherwise-
+            # untracked endings as voluntary -- never as a phantom
+            # deadline miss in the completed-request ring.
+            if srec is not None:
+                srec.abandon("abort")
 
     async def _execute_pd(
         self, ctx, input_ids, worker_sampling, rid, detok, stop_checker,

@@ -58,6 +58,7 @@ class AppContext:
         request_timeout_secs: float | None = None,
         cors_allowed_origins: list | None = None,
         circuit_breaker_config: tuple | None = None,
+        slo_specs=None,
     ):
         from smg_tpu.gateway.auth import AuthConfig, Authenticator
         from smg_tpu.gateway.health import HealthMonitor
@@ -73,6 +74,11 @@ class AppContext:
         self.providers = ProviderRegistry()
         self.tokenizers = TokenizerRegistry()
         self.metrics = Metrics()
+        # declarative SLO enforcement (gateway/slo_enforcement.py): specs
+        # from --slo-spec evaluate over the SloTracker ring; verdicts at
+        # GET /debug/slo/verdicts, violations/burn-rate as metric families
+        if slo_specs:
+            self.metrics.slo_enforcer.install(slo_specs)
         # routing decision ring + reconciliation: every policy instance
         # (existing and lazily created per model) gets the sink
         self.metrics.route.watch(self.policies)
@@ -555,6 +561,9 @@ def build_app(ctx: AppContext, client_max_size: int = 256 * 2**20) -> web.Applic
     # observability.SloTracker): worker black-box dumps + rolling SLO summary
     app.router.add_get("/debug/flight/{worker_id}", h_debug_flight)
     app.router.add_get("/debug/slo", h_debug_slo)
+    # declarative SLO verdicts (gateway/slo_enforcement.py): installed
+    # specs judged over the SLO ring's fast/slow windows on each GET
+    app.router.add_get("/debug/slo/verdicts", h_debug_slo_verdicts)
     # routing-plane observability (gateway/route_observability.py): decision
     # ring + reconciliation, and the gateway-vs-worker kv-index drift audit
     app.router.add_get("/debug/router", h_debug_router)
@@ -679,9 +688,27 @@ async def h_debug_flight(request: web.Request) -> web.Response:
 async def h_debug_slo(request: web.Request) -> web.Response:
     """Rolling gateway-side SLO/goodput summary: TTFT/ITL/e2e percentiles,
     deadline met/missed, goodput token rate, and recent per-request records
-    with trace-id exemplars (observability.SloTracker)."""
+    with trace-id exemplars (observability.SloTracker).  ``?recent=`` bounds
+    the per-request records returned (default 32; capped at the ring size,
+    so ``recent=256`` returns the whole ring)."""
     ctx: AppContext = request.app["ctx"]
-    return web.json_response(ctx.metrics.slo.summary())
+    try:
+        recent = int(request.query.get("recent", 32))
+    except ValueError:
+        return _error(400, "recent must be an integer")
+    recent = max(0, min(recent, ctx.metrics.slo.keep))
+    return web.json_response(ctx.metrics.slo.summary(recent=recent))
+
+
+async def h_debug_slo_verdicts(request: web.Request) -> web.Response:
+    """SLO enforcement verdicts: every installed ``SloSpec`` evaluated NOW
+    over its fast/slow windows of the completed-request ring — per-window
+    stats, breaches, burn rates, and the hysteresis-damped pass/fail
+    verdict (``gateway/slo_enforcement.py``).  Empty spec set answers with
+    ``all_pass: true`` over zero verdicts — nothing declared, nothing
+    enforced."""
+    ctx: AppContext = request.app["ctx"]
+    return web.json_response(ctx.metrics.slo_enforcer.evaluate())
 
 
 async def h_debug_router(request: web.Request) -> web.Response:

@@ -72,7 +72,12 @@ class SchedulerServicer:
             return self.engines[rank]
         if len(self.engines) == 1:
             return self.engine
-        return min(self.engines, key=lambda e: e.loads()["queued_tokens"])
+        # per-dispatch replica pick: skip the loads() leak audit (its radix
+        # lock walk is ops-plane cost, not per-request cost)
+        return min(
+            self.engines,
+            key=lambda e: e.loads(include_audit=False)["queued_tokens"],
+        )
 
     async def Generate(self, request: pb.GenerateRequestProto, context):
         from smg_tpu.engine.request import QueueFullError
@@ -384,7 +389,9 @@ class SchedulerServicer:
         return pb.HealthResponseProto(ok=ok)
 
     async def GetLoads(self, request: pb.EmptyProto, context):
-        per_rank = [e.loads() for e in self.engines]
+        # LoadsProto carries fixed counters only; don't compute the audit
+        # payload the wire format cannot carry (in-proc workers get it)
+        per_rank = [e.loads(include_audit=False) for e in self.engines]
         return pb.LoadsProto(
             num_waiting=sum(l["num_waiting"] for l in per_rank),
             num_running=sum(l["num_running"] for l in per_rank),

@@ -75,7 +75,17 @@ def _tokens(outs: dict, rid: str) -> list:
 
 
 def assert_engine_clean(eng: Engine) -> None:
-    """Zero leaked pages, radix locks, or decode lanes after all finishes."""
+    """Zero leaked pages, radix locks, or decode lanes after all finishes.
+
+    Asserts BOTH through the public quiescence audit (``Engine.audit()`` —
+    what operators and the loadgen harness read via ``loads()``) and by
+    independent internal walk, so a bug in the audit itself cannot hide a
+    leak from this suite."""
+    audit = eng.audit()
+    assert audit["quiescent"] and audit["clean"], audit
+    assert audit["leaked_pages"] == 0, audit
+    assert audit["radix_locked_nodes"] == 0 == audit["radix_lock_refcounts"], audit
+    assert audit["pending_callbacks"] == 0, audit
     sch = eng.scheduler
     assert sch.requests == {}, f"leaked requests: {list(sch.requests)}"
     assert all(s is None for s in sch.slots), "leaked decode lane"

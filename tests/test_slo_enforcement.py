@@ -1,0 +1,648 @@
+"""SLO enforcement layer + loadgen harness (ISSUE 14).
+
+Covers: declarative ``SloSpec`` parsing, the fast/slow burn-rate window
+math (agreement, empty-window behavior, min-requests gating), verdict
+flapping hysteresis and edge-triggered violation counting, the SLO-record
+termination fix for clients that disconnect between a 429 failover and
+first token, the ``Engine.audit()`` zero-leak surface (incl. the
+abort-frees-pages-within-one-step contract), the ``/debug/slo/verdicts``
+endpoint end to end over an in-proc gateway, and the seeded loadgen smoke
+run (small matrix, 2 workers) — tier-1's copy of the CI §9 scenario.
+"""
+
+import asyncio
+import importlib.util
+import pathlib
+import threading
+
+import pytest
+
+from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+from smg_tpu.engine.engine import Engine
+from smg_tpu.faults import FAULTS
+from smg_tpu.gateway.observability import Metrics
+from smg_tpu.gateway.slo_enforcement import (
+    SloEnforcer,
+    SloSpec,
+    load_slo_specs,
+)
+from smg_tpu.models.config import tiny_test_config
+from smg_tpu.protocols.sampling import SamplingParams
+from smg_tpu.tokenizer import MockTokenizer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    FAULTS.clear()
+
+
+def _load_loadgen():
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "smg_loadgen", REPO / "benches" / "loadgen.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolve string annotations via sys.modules[cls.__module__]
+    sys.modules["smg_loadgen"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---- spec parsing ----
+
+
+def test_spec_rejects_unknown_keys_and_dead_specs():
+    with pytest.raises(ValueError, match="unknown SloSpec key"):
+        SloSpec.from_dict({"name": "x", "ttft_p95": 1.0})  # typo'd key
+    with pytest.raises(ValueError, match="no targets"):
+        SloSpec.from_dict({"name": "x"})
+    with pytest.raises(ValueError, match="fast_window_s"):
+        SloSpec(name="x", ttft_p95_s=1.0, fast_window_s=60, slow_window_s=30)
+    with pytest.raises(ValueError, match="deadline_miss_budget"):
+        SloSpec(name="x", deadline_miss_budget=0.0)
+
+
+def test_load_slo_specs_shapes(tmp_path):
+    specs = load_slo_specs([{"name": "a", "ttft_p95_s": 1.0}])
+    assert [s.name for s in specs] == ["a"]
+    specs = load_slo_specs('{"slos": [{"name": "b", "e2e_p95_s": 2.0}]}')
+    assert specs[0].e2e_p95_s == 2.0
+    p = tmp_path / "slo.json"
+    p.write_text('[{"name": "c", "goodput_ratio_floor": 0.9}]')
+    assert load_slo_specs(str(p))[0].goodput_ratio_floor == 0.9
+    with pytest.raises(ValueError, match="duplicate"):
+        load_slo_specs([{"name": "d", "ttft_p95_s": 1.0},
+                        {"name": "d", "ttft_p95_s": 2.0}])
+
+
+def test_cli_accepts_slo_spec_flag(tmp_path):
+    from smg_tpu.cli import build_parser
+
+    p = tmp_path / "slo.json"
+    p.write_text('[{"name": "prod", "ttft_p95_s": 0.5}]')
+    args = build_parser().parse_args(
+        ["launch", "--slo-spec", str(p), "--port", "0"]
+    )
+    assert args.slo_spec == str(p)
+    assert load_slo_specs(args.slo_spec)[0].name == "prod"
+
+
+# ---- burn-rate window math (stub tracker: ages are controlled) ----
+
+
+def _rec(age_s=0.0, ttft=0.01, itl=0.002, e2e=0.05, deadline=5.0, met=True,
+         voluntary=False, tokens=4):
+    return age_s, {
+        "ttft_s": ttft, "itl_mean_s": itl, "e2e_s": e2e,
+        "deadline_s": deadline, "deadline_met": met, "voluntary": voluntary,
+        "output_tokens": tokens,
+    }
+
+
+class _StubTracker:
+    """window_records by synthetic record age — time-travel for the math."""
+
+    def __init__(self, aged_records):
+        self.aged = aged_records
+
+    def window_records(self, window_secs, now=None):
+        return [r for age, r in self.aged if age <= window_secs]
+
+
+def _enforcer(aged, spec_kw, metrics=None):
+    enf = SloEnforcer(metrics=metrics, tracker=_StubTracker(aged))
+    enf.install([{"name": "t", "fast_window_s": 10.0, "slow_window_s": 100.0,
+                  "min_requests": 2, "hysteresis": 1, **spec_kw}])
+    return enf
+
+
+def test_burn_rate_fast_slow_agreement():
+    """Sustained misses land in BOTH windows -> identical burn, verdict
+    fails; the burn number itself is miss_fraction / budget."""
+    aged = [_rec(age_s=a, met=(i % 2 == 0)) for i, a in
+            enumerate((1, 2, 3, 4, 50, 60, 70, 80))]
+    enf = _enforcer(aged, {"deadline_miss_budget": 0.25})
+    v = enf.evaluate()["verdicts"][0]
+    fast, slow = v["windows"]["fast"], v["windows"]["slow"]
+    assert fast["violating"] and slow["violating"]
+    assert fast["miss_fraction"] == 0.5 and slow["miss_fraction"] == 0.5
+    assert fast["burn_rate"] == slow["burn_rate"] == 2.0  # 0.5 / 0.25
+    assert v["verdict"] == "fail"
+
+
+def test_fast_only_violation_does_not_fail():
+    """A recent blip with a healthy long window must NOT flip the verdict —
+    the multiwindow rule requires sustained violation."""
+    aged = (
+        [_rec(age_s=a, met=False) for a in (1, 2)]       # bad, recent
+        + [_rec(age_s=a, met=True) for a in range(20, 96, 4)]  # long healthy
+    )
+    enf = _enforcer(aged, {"deadline_miss_budget": 0.3})
+    v = enf.evaluate()["verdicts"][0]
+    assert v["windows"]["fast"]["violating"]
+    assert not v["windows"]["slow"]["violating"]
+    assert v["candidate"] == "pass" and v["verdict"] == "pass"
+
+
+def test_empty_window_behavior():
+    """No records: insufficient, zero burn, no breaches, verdict pass —
+    an idle gateway is not in violation."""
+    enf = _enforcer([], {"ttft_p95_s": 0.001, "deadline_miss_budget": 0.01})
+    out = enf.evaluate()
+    v = out["verdicts"][0]
+    for w in v["windows"].values():
+        assert w["requests"] == 0 and not w["sufficient"]
+        assert w["burn_rate"] == 0.0 and w["breaches"] == []
+    assert out["all_pass"]
+
+
+def test_min_requests_gates_thin_windows():
+    aged = [_rec(age_s=1, ttft=9.0, met=False)]  # one terrible request
+    enf = _enforcer(aged, {"ttft_p95_s": 0.1, "deadline_miss_budget": 0.01})
+    v = enf.evaluate()["verdicts"][0]
+    assert not v["windows"]["fast"]["sufficient"]
+    assert v["verdict"] == "pass"
+
+
+def test_burn_breach_requires_min_deadline_requests():
+    """Review fix: the burn breach gates on DEADLINE-CARRYING requests —
+    one missed deadline among deadline-less traffic (miss_fraction 1.0)
+    must not page anyone, even though the window as a whole is
+    'sufficient'."""
+    aged = ([_rec(age_s=1, deadline=None) for _ in range(7)]
+            + [_rec(age_s=1, met=False)])
+    enf = _enforcer(aged, {"deadline_miss_budget": 0.1})
+    v = enf.evaluate()["verdicts"][0]
+    fast = v["windows"]["fast"]
+    assert fast["sufficient"] and fast["with_deadline"] == 1
+    assert fast["burn_rate"] == 10.0  # observable, but not actionable alone
+    assert "deadline_miss_budget" not in fast["breaches"]
+    assert v["verdict"] == "pass"
+
+
+def test_percentile_targets_breach_by_name():
+    aged = [_rec(age_s=1, ttft=5.0, itl=1.0, e2e=9.0, tokens=0)
+            for _ in range(4)]
+    enf = _enforcer(aged, {"ttft_p95_s": 1.0, "itl_p95_s": 0.5,
+                           "e2e_p95_s": 2.0, "goodput_ratio_floor": 0.9})
+    v = enf.evaluate()["verdicts"][0]
+    # zero tokens -> goodput vacuously 1.0, so the floor must NOT breach
+    assert set(v["windows"]["fast"]["breaches"]) == {
+        "ttft_p95_s", "itl_p95_s", "e2e_p95_s"
+    }
+
+
+def test_verdict_flapping_hysteresis_and_violation_edges():
+    """hysteresis=2: a boundary flapping pass/fail per evaluation never
+    flips the verdict; two consecutive disagreements do.  The violations
+    counter increments on window onset EDGES, not per evaluation."""
+    metrics = Metrics()
+    bad = [_rec(age_s=1, met=False) for _ in range(4)]
+    good = [_rec(age_s=1, met=True) for _ in range(4)]
+    tracker = _StubTracker(bad)
+    enf = SloEnforcer(metrics=metrics, tracker=tracker)
+    enf.install([{"name": "flap", "deadline_miss_budget": 0.1,
+                  "fast_window_s": 10, "slow_window_s": 100,
+                  "min_requests": 2, "hysteresis": 2}])
+
+    def counter(window):
+        for fam in metrics.registry.collect():
+            for s in fam.samples:
+                if (s.name == "smg_slo_violations_total"
+                        and s.labels.get("window") == window):
+                    return s.value
+        return 0.0
+
+    # flap: bad, good, bad, good ... verdict must stay pass throughout
+    for i in range(4):
+        tracker.aged = bad if i % 2 == 0 else good
+        v = enf.evaluate()["verdicts"][0]
+        assert v["verdict"] == "pass", f"flipped on flap iteration {i}"
+    # each bad evaluation after a good one is a fresh onset: 2 edges so far
+    assert counter("fast") == 2.0
+    # sustained: two consecutive bad evaluations flip it
+    tracker.aged = bad
+    assert enf.evaluate()["verdicts"][0]["verdict"] == "pass"  # streak 1
+    v = enf.evaluate()["verdicts"][0]
+    assert v["verdict"] == "fail"  # streak 2 -> flip
+    # still-violating re-evaluations do NOT count new violations
+    assert counter("fast") == 3.0
+    enf.evaluate()
+    assert counter("fast") == 3.0
+    # sustained recovery flips back after hysteresis evaluations
+    tracker.aged = good
+    assert enf.evaluate()["verdicts"][0]["verdict"] == "fail"
+    assert enf.evaluate()["verdicts"][0]["verdict"] == "pass"
+
+
+def test_tracker_window_records_filters_by_age():
+    import time as _time
+
+    m = Metrics()
+    r = m.slo.begin("old")
+    r.first_token(4, 0)
+    r.finish("stop")
+    now = _time.perf_counter()
+    assert len(m.slo.window_records(60.0, now=now)) == 1
+    # a "now" far in the future ages the record out of the window
+    assert m.slo.window_records(1.0, now=now + 100.0) == []
+
+
+# ---- SLO record termination on client disconnect (regression) ----
+
+
+class _QueueFullClient:
+    """Always rejects with backpressure after a short dispatch delay."""
+
+    proxy_mode = False
+
+    async def generate(self, req):
+        from smg_tpu.gateway.worker_client import WorkerQueueFullError
+
+        await asyncio.sleep(0.01)
+        raise WorkerQueueFullError("induced")
+        yield  # pragma: no cover
+
+    async def abort(self, rid):
+        return True
+
+    async def close(self):
+        pass
+
+
+class _NeverFirstTokenClient:
+    """Accepts the dispatch but never produces a first token."""
+
+    proxy_mode = False
+
+    def __init__(self):
+        self.dispatched = asyncio.Event()
+
+    async def generate(self, req):
+        self.dispatched.set()
+        await asyncio.Event().wait()
+        yield  # pragma: no cover
+
+    async def abort(self, rid):
+        return True
+
+    async def close(self):
+        pass
+
+
+class _FailingClient:
+    """Generic dispatch failure (drives the retry-backoff path)."""
+
+    proxy_mode = False
+
+    async def generate(self, req):
+        await asyncio.sleep(0.01)
+        raise RuntimeError("boom")
+        yield  # pragma: no cover
+
+    async def abort(self, rid):
+        return True
+
+    async def close(self):
+        pass
+
+
+def _router_with(clients):
+    from smg_tpu.gateway.router import Router, RouterConfig
+    from smg_tpu.gateway.workers import Worker, WorkerRegistry
+    from smg_tpu.policies import PolicyRegistry
+    from smg_tpu.tokenizer.registry import TokenizerRegistry
+
+    registry = WorkerRegistry()
+    for i, c in enumerate(clients):
+        registry.add(Worker(worker_id=f"w{i}", client=c, model_id="m"))
+    metrics = Metrics()
+    router = Router(
+        registry, PolicyRegistry(default="round_robin"), TokenizerRegistry(),
+        config=RouterConfig(request_timeout_secs=5.0), metrics=metrics,
+    )
+    return router, metrics
+
+
+def _deadline_counts(metrics):
+    met = missed = 0.0
+    for fam in metrics.registry.collect():
+        for s in fam.samples:
+            if s.name == "smg_request_deadline_outcomes_total":
+                if s.labels.get("outcome") == "met":
+                    met = s.value
+                elif s.labels.get("outcome") == "missed":
+                    missed = s.value
+    return met, missed
+
+
+def _cancelled_execute(router, cancel_after: float):
+    from smg_tpu.policies import RequestContext
+
+    async def go():
+        async def consume():
+            ctx = RequestContext(model_id="m", request_id="r1")
+            async for _ev in router._execute(
+                ctx, [1, 2, 3], SamplingParams(max_new_tokens=4), "r1", None
+            ):
+                pass
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(cancel_after)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(go())
+
+
+def test_disconnect_between_429_failover_and_first_token_not_a_miss():
+    """Regression (ISSUE 14 satellite): a streaming client that disconnects
+    AFTER a queue-full failover but BEFORE the first token must terminate
+    its SloRequest as a VOLUNTARY ending — one ring record, zero deadline
+    outcomes — never leak or land as a phantom deadline miss."""
+    hang = _NeverFirstTokenClient()
+    router, metrics = _router_with([_QueueFullClient(), hang])
+    _cancelled_execute(router, cancel_after=0.15)
+    s = metrics.slo.summary()
+    assert s["window_requests"] == 1, (
+        "disconnect at the failover seam must still terminate the SLO record"
+    )
+    rec = s["recent"][-1]
+    assert rec["voluntary"] is True and rec["deadline_met"] is False
+    assert s["deadline"] == {"with_deadline": 0, "met": 0, "missed": 0}
+    assert _deadline_counts(metrics) == (0.0, 0.0)
+    assert hang.dispatched.is_set(), "failover never reached the second worker"
+
+
+def test_disconnect_during_retry_backoff_terminates_record():
+    """The other half of the seam: cancellation during the retry BACKOFF
+    sleep is raised inside an except handler, bypassing the loop's own
+    GeneratorExit/CancelledError arm — only the termination backstop
+    records it.  Pre-fix this leaked the handle (no ring record at all)."""
+    router, metrics = _router_with([_FailingClient(), _FailingClient()])
+    # first dispatch fails at ~10ms, then backoff sleeps 100ms: cancel lands
+    # inside the sleep
+    _cancelled_execute(router, cancel_after=0.05)
+    s = metrics.slo.summary()
+    assert s["window_requests"] == 1, (
+        "cancellation during retry backoff leaked the SLO record"
+    )
+    assert s["recent"][-1]["voluntary"] is True
+    assert _deadline_counts(metrics) == (0.0, 0.0)
+
+
+# ---- Engine.audit (zero-leak quiescence surface) ----
+
+
+def make_engine(**sched_kw) -> Engine:
+    sched = dict(
+        max_batch_size=4, max_seq_len=128, max_prefill_tokens=32,
+        prefill_token_buckets=(16, 32, 64), decode_batch_buckets=(4,),
+    )
+    sched.update(sched_kw)
+    return Engine(EngineConfig(
+        model=tiny_test_config(),
+        cache=CacheConfig(page_size=16, num_pages=128, auto_size=False,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(**sched),
+        dtype="float32", model_id="tiny-slo",
+        flight_dump_min_interval_secs=0.0,
+    ), tokenizer=MockTokenizer())
+
+
+def test_engine_audit_clean_after_traffic_and_rides_loads():
+    eng = make_engine()
+    for prompt in ([5, 6, 7], list(range(2, 40))):
+        eng.generate(prompt_ids=prompt, sampling=SamplingParams(
+            temperature=0.0, max_new_tokens=4, ignore_eos=True))
+    a = eng.audit()
+    assert a["quiescent"] and a["clean"]
+    assert a["leaked_pages"] == 0 and a["radix_lock_refcounts"] == 0
+    assert a["pending_callbacks"] == 0 and a["inflight_frames"] == 0
+    # every allocatable page is free or radix-cached at quiescence
+    assert a["free_pages"] + a["radix_cached_pages"] == a["allocatable_pages"]
+    # the same verdict rides loads() (and therefore /scheduler)
+    loads = eng.loads()
+    assert loads["audit"]["clean"] is True
+    # hot callers can skip the audit walk
+    assert "audit" not in eng.loads(include_audit=False)
+    eng.stop()
+
+
+def test_engine_audit_mid_flight_sees_pins_but_no_leaks():
+    eng = make_engine()
+    outs: dict = {}
+    # 36-token prompt -> 2 full pages bank into the radix cache; the second
+    # request shares them, pinning the chain
+    base = list(range(2, 38))
+    eng.generate(prompt_ids=base, sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=2, ignore_eos=True))
+    eng.submit(base + [40, 41], SamplingParams(
+        temperature=0.0, max_new_tokens=8, ignore_eos=True), rid="x",
+        on_output=lambda o: outs.setdefault("x", []).append(o))
+    eng.step()
+    a = eng.audit()
+    assert not a["quiescent"] and a["live_slots"] == 1
+    assert a["leaked_pages"] == 0 and a["clean"], a
+    assert a["radix_lock_refcounts"] > 0  # the shared prefix is pinned
+    assert a["pinned_shared_pages"] >= 2
+    while not (outs.get("x") and outs["x"][-1].finished):
+        eng.step()
+    fin = eng.audit()
+    assert fin["quiescent"] and fin["clean"]
+    assert fin["radix_lock_refcounts"] == 0
+    eng.stop()
+
+
+def test_aborted_lane_frees_pages_within_one_step():
+    """ISSUE 14 satellite (disconnect hardening): an aborted RUNNING lane's
+    slot, pages, and radix locks are released by the abort itself — at most
+    one step later the audit is clean.  Driven through the public abort
+    path (what a client disconnect triggers via the router), no
+    monkeypatching."""
+    eng = make_engine()
+    outs: dict = {}
+    free_before = eng.audit()["free_pages"]
+    eng.submit(list(range(2, 38)), SamplingParams(
+        temperature=0.0, max_new_tokens=64, ignore_eos=True), rid="gone",
+        on_output=lambda o: outs.setdefault("gone", []).append(o))
+    for _ in range(3):
+        eng.step()
+    assert eng.audit()["live_slots"] == 1
+    assert eng.abort("gone") is True
+    eng.step()  # the one allowed step
+    a = eng.audit()
+    assert a["quiescent"] and a["clean"], a
+    assert a["leaked_pages"] == 0 and a["radix_lock_refcounts"] == 0
+    # pages returned: free + newly-banked radix pages cover what it held
+    assert a["free_pages"] + a["radix_cached_pages"] >= free_before
+    eng.stop()
+
+
+def test_worker_stream_fault_disconnect_excluded_and_clean():
+    """The faults.py seam doubles as the disconnect fault test: a
+    worker.stream fault kills the transport mid-stream; the engine-side
+    lane aborts, pages free, and the gateway SLO layer must not count a
+    deadline outcome for it (the router surfaces it as a worker error or
+    abandoned stream, both non-goodput)."""
+    eng = make_engine()
+    a0 = eng.audit()
+    assert a0["clean"]
+    FAULTS.arm("worker.stream", mode="after", n=2, match="die-me")
+
+    from smg_tpu.gateway.worker_client import (
+        InProcWorkerClient,
+        WorkerGenerateRequest,
+    )
+
+    client = InProcWorkerClient(eng)
+
+    async def go():
+        req = WorkerGenerateRequest(
+            rid="die-me", input_ids=[5, 6, 7],
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=32,
+                                    ignore_eos=True))
+        try:
+            async for _ in client.generate(req):
+                pass
+        except Exception:
+            await client.abort("die-me")
+
+    asyncio.run(go())
+    import time as _time
+
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline:
+        a = eng.audit()
+        if a["quiescent"] and a["clean"]:
+            break
+        _time.sleep(0.05)
+    assert a["quiescent"] and a["clean"], a
+    FAULTS.clear()
+    eng.stop()
+
+
+# ---- /debug/slo/verdicts end to end + injected violation dump fetch ----
+
+
+def test_slo_verdicts_endpoint_and_violation_dump_fetch():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from smg_tpu.gateway.server import AppContext, build_app
+    from smg_tpu.gateway.worker_client import InProcWorkerClient
+    from smg_tpu.gateway.workers import Worker
+
+    eng = make_engine()
+    ctx = AppContext(policy="round_robin", slo_specs=[{
+        "name": "tier1", "ttft_p95_s": 30.0, "goodput_ratio_floor": 0.2,
+        "deadline_miss_budget": 0.9, "min_requests": 1, "hysteresis": 1,
+    }], request_timeout_secs=60.0)
+    ctx.tokenizers.register("tiny-slo", MockTokenizer(), default=True)
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+
+    def run(coro, timeout=180):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout)
+
+    async def _setup():
+        ctx.registry.add(Worker(worker_id="w0", client=InProcWorkerClient(eng),
+                                model_id="tiny-slo"))
+        tc = TestClient(TestServer(build_app(ctx)))
+        await tc.start_server()
+        return tc
+
+    tc = run(_setup())
+    try:
+        async def drive():
+            r = await tc.post("/v1/chat/completions", json={
+                "model": "tiny-slo",
+                "messages": [{"role": "user", "content": "w5 w6 w7"}],
+                "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+            })
+            assert r.status == 200
+            rv = await tc.get("/debug/slo/verdicts")
+            return rv.status, await rv.json()
+
+        status, body = run(drive())
+        assert status == 200 and body["schema_version"] == 1
+        assert body["all_pass"] is True
+        (v,) = body["verdicts"]
+        assert v["slo"] == "tier1" and v["verdict"] == "pass"
+        assert v["windows"]["fast"]["requests"] >= 1
+
+        # ?recent= bounds the /debug/slo per-request slice (review fix: the
+        # loadgen reads the WHOLE ring via recent=256 for exact tiling)
+        async def slo_slices():
+            r1 = await tc.get("/debug/slo", params={"recent": "0"})
+            r2 = await tc.get("/debug/slo", params={"recent": "256"})
+            return await r1.json(), await r2.json()
+
+        s0, s_all = run(slo_slices())
+        assert s0["recent"] == []
+        assert len(s_all["recent"]) == s_all["window_requests"]
+
+        # inject a violation window: impossible TTFT target -> verdict
+        # fails -> a flight-recorder dump is fetchable for the window
+        ctx.metrics.slo_enforcer.install([{
+            "name": "injected", "ttft_p95_s": 1e-9,
+            "min_requests": 1, "hysteresis": 1,
+        }])
+
+        async def violated():
+            rv = await tc.get("/debug/slo/verdicts")
+            body = await rv.json()
+            fr = await tc.get("/debug/flight/w0",
+                              params={"reason": "slo_violation"})
+            return body, fr.status, await fr.json()
+
+        body, fstatus, fbody = run(violated())
+        injected = next(v for v in body["verdicts"] if v["slo"] == "injected")
+        assert injected["verdict"] == "fail"
+        assert "ttft_p95_s" in injected["windows"]["fast"]["breaches"]
+        assert not body["all_pass"]
+        assert fstatus == 200 and "schema_version" in fbody["dump"]
+        # the violation onset landed in the metric family
+        count = 0.0
+        for fam in ctx.metrics.registry.collect():
+            for s in fam.samples:
+                if (s.name == "smg_slo_violations_total"
+                        and s.labels.get("slo") == "injected"):
+                    count += s.value
+        assert count >= 2.0  # fast + slow onsets
+    finally:
+        run(tc.close())
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+
+
+# ---- the seeded loadgen smoke (tier-1 copy of CI §9) ----
+
+
+def test_loadgen_smoke_small_matrix():
+    """Small mixed matrix (all scenarios at half scale), 2 in-proc workers,
+    seeded: every epilogue check must pass — SLO verdicts, goodput floor,
+    disconnect exclusion, router band, 429-without-breaker-penalty,
+    drain-under-load, zero-leak audits, and the injected-violation flight
+    dump."""
+    lg = _load_loadgen()
+    cfg = lg.LoadgenConfig(seed=0, workers=2, scale=0.5, rate_rps=40.0)
+    results = lg.run(cfg)
+    failed = {k: c for k, c in results["checks"].items() if not c["ok"]}
+    assert results["ok"], f"loadgen checks failed: {failed}"
+    # deterministic step-count spot checks (temp 0, ignore_eos, fixed seed)
+    sc = results["scenarios"]
+    assert sc["short_chat"]["completed"] == sc["short_chat"]["requests"]
+    assert sc["zipf_session"]["output_tokens"] == 2 * sc["zipf_session"]["requests"]
+    assert sc["stream_disconnect"]["disconnected"] > 0
+    assert results["verdicts"]["all_pass"]
+    audits = results["audit"]["engines"]
+    assert all(a["leaked_pages"] == 0 for a in audits.values())
