@@ -61,7 +61,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 ALL_SCENARIOS = (
     "short_chat", "long_prefill", "json_mode", "tool_loop",
-    "stream_disconnect", "zipf_session",
+    "stream_disconnect", "zipf_session", "tp_worker",
 )
 
 #: smoke-grade SLO spec: the verdicts must PASS on a healthy stack, so the
@@ -131,18 +131,41 @@ class LoadgenConfig:
     page_size: int = 16
     max_seq_len: int = 192
     model_id: str = "tiny-loadgen"
+    # tensor-parallel in-proc worker: with the "tp_worker" scenario enabled,
+    # worker 0 runs a tp=tp_mesh sharded engine (needs that many jax
+    # devices; loadgen forces an 8-device CPU mesh before jax imports)
+    tp_mesh: int = 2
 
 
 def build_engine(cfg: LoadgenConfig, idx: int):
-    from smg_tpu.engine.config import CacheConfig, EngineConfig, SchedulerConfig
+    from smg_tpu.engine.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+    )
     from smg_tpu.engine.engine import Engine
     from smg_tpu.models.config import tiny_test_config
     from smg_tpu.tokenizer import MockTokenizer
+
+    parallel = None
+    devices = None
+    if idx == 0 and "tp_worker" in cfg.scenarios and cfg.tp_mesh > 1:
+        # worker 0 is the fleet's tensor-parallel worker: same weights
+        # (seed 0), sharded over a tp mesh — the matrix exercises it
+        # through the same gateway path as every single-device peer
+        import jax
+
+        devs = jax.devices("cpu")
+        if len(devs) >= cfg.tp_mesh:
+            parallel = ParallelConfig(tp=cfg.tp_mesh)
+            devices = devs[: cfg.tp_mesh]
+        else:  # no silent caps: say the TP leg degraded to single-device
+            print(json.dumps({"bench": "loadgen_tp_worker",
+                              "skipped": f"{len(devs)} devices < tp={cfg.tp_mesh}"}))
 
     model = tiny_test_config()
     return Engine(
         EngineConfig(
             model=model,
+            parallel=parallel or ParallelConfig(),
             cache=CacheConfig(page_size=cfg.page_size, num_pages=cfg.num_pages,
                               auto_size=False, dtype="float32"),
             scheduler=SchedulerConfig(
@@ -160,6 +183,7 @@ def build_engine(cfg: LoadgenConfig, idx: int):
             flight_dump_min_interval_secs=0.0,
         ),
         tokenizer=MockTokenizer(vocab_size=model.vocab_size),
+        devices=devices,
     )
 
 
@@ -397,6 +421,17 @@ def build_matrix(cfg: LoadgenConfig, tc) -> list:
                             _completion_ids(tc, "zipf_session", input_ids=x,
                                             max_tokens=2)))
 
+    if "tp_worker" in cfg.scenarios:
+        # medium decode runs with shared prefixes: the cache-aware policy
+        # concentrates them, so some land on the TP worker (w0) — asserted
+        # via its loads()["mesh"] + nonzero decode counters in the epilogue
+        base = [rng.randrange(2, vocab) for _ in range(24)]
+        for off in poisson_offsets(n(6), cfg.rate_rps / 3):
+            ids = base + [rng.randrange(2, vocab) for _ in range(8)]
+            entries.append((off, "tp_worker", lambda x=ids:
+                            _completion_ids(tc, "tp_worker", input_ids=x,
+                                            max_tokens=8)))
+
     entries.sort(key=lambda e: e[0])
     return entries
 
@@ -491,6 +526,18 @@ async def _run_async(cfg: LoadgenConfig) -> dict:
               errors == 0 and rejected <= max(1, int(0.1 * total)),
               requests=total, errors=errors, rejected=rejected,
               disconnected=disconnects)
+
+        if "tp_worker" in cfg.scenarios:
+            # the TP leg: worker 0 must actually be sharded (unless devices
+            # were short — then build_engine already reported the skip) and
+            # must have served decode traffic through the shared gateway
+            mesh = engines[0].loads(include_audit=False)["mesh"]
+            w0_decode = engines[0].scheduler.num_decode_tokens
+            results["tp_worker"] = {"mesh": mesh, "decode_tokens": w0_decode}
+            if engines[0].runner.mesh is not None:
+                check("tp_worker_sharded",
+                      mesh["devices"] == cfg.tp_mesh and w0_decode > 0,
+                      mesh=mesh, decode_tokens=w0_decode)
 
         # give voluntary-abort bookkeeping a moment to settle before judging
         await asyncio.sleep(0.3)
@@ -734,6 +781,14 @@ def main(argv=None) -> int:
         from smg_tpu.gateway.slo_enforcement import load_slo_specs
 
         slo_specs = [s.__dict__ for s in load_slo_specs(args.slo_spec)]
+    if "tp_worker" in scenarios and "jax" not in sys.modules:
+        # the TP worker needs a multi-device CPU backend; the flag must land
+        # before jax initializes (no-op when the env already forces one)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     cfg = LoadgenConfig(
         seed=args.seed, workers=args.workers, scale=args.scale,
         scenarios=scenarios, arrival=args.arrival, rate_rps=args.rate_rps,

@@ -63,7 +63,15 @@
 #                       429-with-breaker-penalty, dropped stream under
 #                       drain, missing violation-window flight dump, or
 #                       nonzero leak audit at quiescence
-#                       (benches/loadgen.py --seed 0 --workers 2).
+#                       (benches/loadgen.py --seed 0 --workers 2);
+#   10. tensor-parallel sharded decode — byte-parity vs single-device on
+#                       the forced 8-device CPU mesh (temp 0/0.8, overlap
+#                       on/off, K∈{1,4}, chunked prefill, speculation),
+#                       tp4 kv-head replication fallback, tp8 steady-state
+#                       transfer-guard/0-recompile, adaptive-K single
+#                       trace, zero-leak audit, donation policy table,
+#                       mesh observability surfaces
+#                       (tests/test_tp_decode.py).
 #
 # Usage: scripts/ci_checks.sh
 set -euo pipefail
@@ -107,5 +115,9 @@ echo "== SLO enforcement + seeded loadgen smoke =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_slo_enforcement.py -q \
     -m 'not slow' -p no:cacheprovider
 JAX_PLATFORMS=cpu python benches/loadgen.py --seed 0 --workers 2
+
+echo "== tensor-parallel sharded decode (8-device CPU mesh) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_tp_decode.py -q \
+    -m 'not slow' -p no:cacheprovider
 
 echo "ci_checks: all green"

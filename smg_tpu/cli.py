@@ -226,7 +226,17 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--model-path", default=None, help="HF-format model dir")
     g.add_argument("--model-preset", default=None, help="named preset (tiny, llama3-8b, ...)")
     g.add_argument("--tokenizer-path", default=None)
-    g.add_argument("--tp", type=int, default=1, help="tensor parallel size")
+    g.add_argument("--tp", "--tensor-parallel-size", type=int, default=1,
+                   dest="tp",
+                   help="tensor parallel size (heads/ffn/vocab sharded over "
+                        "the mesh's innermost axis; KV pages shard their "
+                        "fused lane dim).  tp=1 is byte-identical to the "
+                        "single-device engine")
+    g.add_argument("--mesh-shape", default=None, dest="mesh_shape",
+                   help="full mesh topology as axis=N pairs, e.g. "
+                        "'tp=4' or 'dp=2,tp=4' (axes: dp/tp/sp/ep/pp; "
+                        "unnamed axes stay 1).  Conflicts with a differing "
+                        "per-axis flag are a startup error")
     g.add_argument("--dp", type=int, default=1, help="data parallel size")
     g.add_argument("--pp", type=int, default=1,
                    help="pipeline parallel size (layer stack + KV sharded)")

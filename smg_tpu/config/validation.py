@@ -399,6 +399,32 @@ def validate_cli_args(args) -> list[ValidationIssue]:
             "--decode-horizon-max above 1) never fuses steps",
         ))
 
+    # ---- parallel mesh shape (serve/worker mode)
+    if g("mesh_shape"):
+        from smg_tpu.engine.config import ParallelConfig
+
+        try:
+            shaped = ParallelConfig.from_spec(g("mesh_shape"))
+        except ValueError as e:
+            shaped = None
+            issues.append(_err("mesh_shape", str(e)))
+        if shaped is not None:
+            # a per-axis flag that disagrees with an axis the spec NAMES is
+            # a conflict, not a merge; axes the spec leaves out merge from
+            # the flags at launch (from_spec base=), so they are not checked
+            named = {
+                part.partition("=")[0].strip()
+                for part in g("mesh_shape").split(",") if part.strip()
+            }
+            for axis, size in shaped.axis_sizes().items():
+                flag = g(axis, 1) or 1
+                if axis in named and flag != 1 and size != flag:
+                    issues.append(_err(
+                        "mesh_shape",
+                        f"--mesh-shape sets {axis}={size} but --{axis}={flag}; "
+                        f"drop one",
+                    ))
+
     # ---- mesh TLS coherence
     tls_parts = [g("mesh_tls_cert"), g("mesh_tls_key"), g("mesh_tls_ca")]
     if any(tls_parts) and not all(tls_parts):

@@ -43,6 +43,36 @@ class ParallelConfig:
     def axis_sizes(self) -> dict[str, int]:
         return {"dp": self.dp, "tp": self.tp, "sp": self.sp, "ep": self.ep, "pp": self.pp}
 
+    @classmethod
+    def from_spec(cls, spec: str, base: "ParallelConfig | None" = None) -> "ParallelConfig":
+        """Parse a ``--mesh-shape`` string ("tp=4" / "dp=2,tp=4") over
+        ``base`` (axes not named keep the base's value).  Raises ValueError
+        on unknown axes, malformed entries, or sizes < 1 — the CLI
+        validation layer turns these into startup errors."""
+        sizes = (base or cls()).axis_sizes()
+        seen: set[str] = set()
+        for part in (p.strip() for p in spec.split(",") if p.strip()):
+            axis, sep, val = part.partition("=")
+            axis = axis.strip()
+            if not sep or axis not in sizes:
+                raise ValueError(
+                    f"mesh-shape entry {part!r}: expected axis=N with axis "
+                    f"in {sorted(sizes)}"
+                )
+            if axis in seen:
+                # a repeated axis is a typo, not an override — last-wins
+                # would silently boot the wrong topology
+                raise ValueError(f"mesh-shape names {axis!r} twice")
+            seen.add(axis)
+            try:
+                n = int(val)
+            except ValueError:
+                raise ValueError(f"mesh-shape entry {part!r}: size must be an int") from None
+            if n < 1:
+                raise ValueError(f"mesh-shape entry {part!r}: size must be >= 1")
+            sizes[axis] = n
+        return cls(**sizes)
+
 
 @dataclass(frozen=True)
 class CacheConfig:

@@ -77,6 +77,7 @@ class Engine:
             window_secs=config.metrics_window_secs,
             device_sample_interval_secs=config.device_metrics_interval_secs,
         )
+        self.metrics.set_mesh_devices(self.runner.mesh_devices)
         self._metric_devices: list | None = None  # built lazily, once
         self.scheduler = Scheduler(
             self.runner, config, event_sink=self.events.publish,

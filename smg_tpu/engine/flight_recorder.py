@@ -59,8 +59,10 @@ logger = get_logger("engine.flight_recorder")
 #: (v2: megastep decode telemetry — per-step horizon K, device early
 #: exits, and wasted-token count joined the step record; v3: speculative
 #: decoding — per-step drafted/accepted token counts from the fused
-#: verify blocks consumed that step)
-SCHEMA_VERSION = 3
+#: verify blocks consumed that step; v4: tensor-parallel sharded decode —
+#: the engine's mesh device count rides every step record, so rings pulled
+#: from a mixed single-device/TP fleet self-describe their topology)
+SCHEMA_VERSION = 4
 
 #: stable key set of one step record (schema contract, tested)
 STEP_RECORD_KEYS = frozenset({
@@ -68,7 +70,7 @@ STEP_RECORD_KEYS = frozenset({
     "prefill_tokens", "decode_tokens", "prefill_inflight_tokens",
     "free_pages", "admissions", "finishes", "overlap", "fetch_wait_s",
     "faults", "horizon", "early_exits", "wasted_decode_tokens",
-    "spec_drafted", "spec_accepted",
+    "spec_drafted", "spec_accepted", "mesh",
 })
 
 
@@ -194,6 +196,7 @@ class FlightRecorder:
         horizon: int = 0, early_exits: int = 0,
         wasted_decode_tokens: int = 0,
         spec_drafted: int = 0, spec_accepted: int = 0,
+        mesh: int = 1,
     ) -> int:
         """Append one step record; returns the step serial.  Called once per
         scheduler step with values already in hand — no derivation here."""
@@ -234,6 +237,10 @@ class FlightRecorder:
                 # the fused verify blocks consumed this step
                 "spec_drafted": spec_drafted,
                 "spec_accepted": spec_accepted,
+                # sharded decode: devices in this engine's mesh (1 =
+                # single-device; static per engine, but the ring is often
+                # read detached from the engine that produced it)
+                "mesh": mesh,
             })
             return self.step_serial
 

@@ -338,6 +338,23 @@ class EngineMetrics:
             "rate vs overlap_host_busy gives the pipeline balance",
             registry=r,
         ))
+        # tensor-parallel sharded decode (first-class runner mode)
+        self.mesh_devices = _track(Gauge(
+            "smg_engine_mesh_devices",
+            "Devices in this engine's mesh (1 = single-device; tp*dp*sp*"
+            "ep*pp otherwise) — the unit the per-worker throughput story "
+            "multiplies over",
+            registry=r,
+        ))
+        self.dispatch_seconds = _track(Counter(
+            "smg_engine_dispatch_seconds_total",
+            "Per-step host time by dispatch phase: enqueue = async launch "
+            "of the (sharded or single-device) decode/verify programs, "
+            "fetch = blocked materializing their results.  On a mesh the "
+            "enqueue share is the sharded-dispatch overhead the megastep "
+            "must amortize",
+            ["phase"], registry=r,
+        ))
 
     # ---- registry unification ----
 
@@ -464,6 +481,16 @@ class EngineMetrics:
         self.deferred_fetch.observe(fetch_wait_s)
         self.overlap_host_busy.inc(max(host_s, 0.0))
         self.overlap_device_wait.inc(max(fetch_wait_s, 0.0))
+
+    def observe_dispatch(self, *, enqueue_s: float, fetch_s: float) -> None:
+        """Record one step's dispatch-time split (async launch enqueue vs
+        deferred-fetch block); see ``smg_engine_dispatch_seconds_total``."""
+        self.dispatch_seconds.labels(phase="enqueue").inc(max(enqueue_s, 0.0))
+        self.dispatch_seconds.labels(phase="fetch").inc(max(fetch_s, 0.0))
+
+    def set_mesh_devices(self, n: int) -> None:
+        """One-shot topology gauge (engine construction)."""
+        self.mesh_devices.set(n)
 
     # ---- device memory gauges ----
 

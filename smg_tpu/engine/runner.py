@@ -21,6 +21,7 @@ import numpy as np
 from jax import lax
 
 from smg_tpu.engine.config import EngineConfig
+from smg_tpu.engine.donation import kv_donation_policy
 from smg_tpu.engine.kv_cache import KvCacheSpec, create_kv_buffers, plan_cache
 from smg_tpu.engine.sampling import apply_penalties
 from smg_tpu.engine.sampling import sample_tokens as _sample_fast
@@ -28,23 +29,36 @@ from smg_tpu.engine.sampling import sample_tokens_exact as _sample_exact
 from smg_tpu.models.registry import get_model
 from smg_tpu.ops.rope import rope_frequencies
 from smg_tpu.parallel.mesh import build_mesh
-from smg_tpu.parallel.sharding import ShardingRules, logical_to_sharding, tree_shardings
+from smg_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_sharding,
+    shard_hint,
+    tree_shardings,
+)
 from smg_tpu.utils import get_logger
 
 logger = get_logger("engine.runner")
 
 
-def _dev(x, dtype) -> jax.Array:
+def _dev(x, dtype, sharding=None) -> jax.Array:
     """Explicit upload for decode hot-path inputs: resident ``jax.Array``s
     pass through untouched (the DecodeState steady-state case — zero
     transfers), host values go up via ``jax.device_put`` so the steady-state
     transfer guard (``jax.transfer_guard("disallow")``) can tell intended
-    uploads from accidental ones."""
+    uploads from accidental ones.
+
+    ``sharding`` (the runner's replicated NamedSharding on a mesh) commits
+    host uploads straight to every mesh device: without it an upload lands
+    uncommitted on the default device and every sharded jit launch pays an
+    IMPLICIT device-to-device reshard — ~10 per step, and the first thing
+    the steady-state transfer guard trips on under tp>1."""
     if isinstance(x, jax.Array):
         # a dtype mismatch here means a scheduler path built the wrong
         # buffer; the eager convert below would be an implicit transfer the
         # guard rightly rejects, so keep it visible rather than masked
         return x if x.dtype == dtype else jnp.asarray(x, dtype)
+    if sharding is not None:
+        return jax.device_put(np.asarray(x, dtype), sharding)
     return jax.device_put(np.asarray(x, dtype))
 
 
@@ -142,22 +156,46 @@ class ModelRunner:
         # host, tests on virtual CPU devices): committing params + KV buffers
         # to the device makes every jit follow them there
         self._device = devices[0] if (devices and world == 1) else None
+        # the replicated NamedSharding every non-sharded step input commits
+        # to under a mesh: host uploads born mesh-resident cost one explicit
+        # h2d broadcast instead of an implicit per-launch reshard
+        self._replicated = (
+            logical_to_sharding((), self.mesh, self.rules)
+            if self.mesh is not None else None
+        )
 
         self.inv_freq = jnp.asarray(
             rope_frequencies(
                 self.model_cfg.head_dim, self.model_cfg.rope_theta, self.model_cfg.rope_scaling
             )
         )
+        if self._replicated is not None:
+            self.inv_freq = jax.device_put(self.inv_freq, self._replicated)
 
         key = jax.random.PRNGKey(config.seed)
         self.param_shardings = None
         if self.mesh is not None:
+            # shape-aware: logical axes whose mesh axis doesn't divide the
+            # actual dim (a 2-kv-head model on a tp=4 mesh) replicate that
+            # dim instead of failing at trace time
+            if params is not None:
+                shapes = params
+            else:
+                shapes = jax.eval_shape(
+                    partial(self.module.init_params, self.model_cfg), key
+                )
             self.param_shardings = tree_shardings(
-                self.module.logical_axes(self.model_cfg), self.mesh, self.rules
+                self.module.logical_axes(self.model_cfg), self.mesh, self.rules,
+                shapes=shapes,
             )
         if params is not None:
             self.params = params
-            if self._device is not None:
+            if self.mesh is not None:
+                # loaded checkpoints arrive as host/default-device arrays;
+                # commit them to their shardings ONCE here or every sharded
+                # jit call re-scatters the full weights
+                self.params = jax.device_put(self.params, self.param_shardings)
+            elif self._device is not None:
                 self.params = jax.device_put(self.params, self._device)
         elif self.mesh is not None:
             # smglint: disable-next=RETRACE one-shot weight init at construction
@@ -186,12 +224,12 @@ class ModelRunner:
         if self.mesh is not None:
             from smg_tpu.models.llama import kv_cache_logical_axes
 
-            kv_sharding = logical_to_sharding(kv_cache_logical_axes(), self.mesh, self.rules)
-            self._replicated = logical_to_sharding((), self.mesh, self.rules)
-        else:
-            self._replicated = None
-            if self._device is not None:
-                kv_sharding = jax.sharding.SingleDeviceSharding(self._device)
+            kv_sharding = logical_to_sharding(
+                kv_cache_logical_axes(), self.mesh, self.rules,
+                shape=self.spec.shape,
+            )
+        elif self._device is not None:
+            kv_sharding = jax.sharding.SingleDeviceSharding(self._device)
         self.kv_sharding = kv_sharding
         self.k_cache, self.v_cache = create_kv_buffers(self.spec, kv_sharding)
         logger.info(
@@ -206,7 +244,36 @@ class ModelRunner:
         )
         self.attn_impl = self._resolve_attn_impl()
         logger.info("attention impl: %s", self.attn_impl)
+        # per-backend / per-mode KV donation policy (engine/donation.py) —
+        # resolved once against where the cache actually lives, replacing
+        # PR 2's runner-internal CPU-overlap heuristic
+        try:
+            platform = self.local_devices()[0].platform
+        except Exception:
+            platform = "unknown"
+        self.donation = kv_donation_policy(
+            platform,
+            overlap_active=config.scheduler.overlap_schedule,
+            sharded=self.mesh is not None,
+        )
+        logger.info("%s", self.donation.describe())
+        # mesh topology is fixed at construction: resolve the device count
+        # (the single source the metrics gauge, flight ring, and loads()
+        # all read) and the loads()/"/scheduler" snapshot ONCE — loads()
+        # rides hot per-dispatch paths (DP replica pick) that must not
+        # re-probe devices
+        self.mesh_devices = (
+            config.parallel.world_size if self.mesh is not None else 1
+        )
+        self._mesh_info = {
+            "devices": self.mesh_devices,
+            "shape": config.parallel.axis_sizes(),
+            "platform": self.donation.platform,
+            "donate_kv": self.donation.donate_kv,
+        }
         self._rng_key = jax.random.PRNGKey(config.seed ^ 0x5EED)
+        if self._replicated is not None:
+            self._rng_key = jax.device_put(self._rng_key, self._replicated)
         self._fold_in = None  # jitted fold_in, built on first key (see _next_key)
         self._step = 0
         self._compiled: dict = {}
@@ -258,25 +325,6 @@ class ModelRunner:
         else:
             for k in [k for k in self._compiled if k[0] == kind]:
                 del self._compiled[k]
-
-    def _kv_donation_blocks_dispatch(self) -> bool:
-        """True when donating the KV buffers would make jit dispatch
-        synchronous (the CPU PJRT client waits for execution before
-        returning when an input is donated), defeating the overlapped
-        pipeline's async launch.  TPU/GPU clients dispatch donated calls
-        asynchronously, and there donation is non-negotiable (the cache is
-        most of HBM).  Scoped to configurations where the overlapped
-        schedule is actually ACTIVE (overlap on — including speculative
-        decoding, whose batched verify frames stay in flight across steps
-        since the fused spec path landed): a synchronous CPU run gains
-        nothing from async dispatch, so it keeps donation (and the in-place
-        cache update) rather than paying a full cache copy per call."""
-        if not self.config.scheduler.overlap_schedule:
-            return False
-        try:
-            return self.local_devices()[0].platform == "cpu"
-        except Exception:
-            return False
 
     def _attn_impl_for(self, B: int, mp: int) -> str:
         """Per-shape kernel choice.  Short contexts: XLA's fused
@@ -333,6 +381,14 @@ class ModelRunner:
             [self._device] if self._device is not None else jax.devices()[:1]
         )
 
+    def mesh_info(self) -> dict:
+        """Mesh topology snapshot for ``loads()`` / ``/scheduler`` and the
+        launch banner: device count, per-axis shape (all five named axes),
+        the backend platform, and the donation verdict.  Resolved once at
+        construction (topology is immutable); the copy keeps callers from
+        mutating the cached snapshot."""
+        return dict(self._mesh_info)
+
     def _detect_hbm(self) -> int | None:
         """Free HBM on the tightest device this engine will occupy.
 
@@ -358,8 +414,19 @@ class ModelRunner:
         if self._counts_buf is None:
             S = self.config.scheduler.max_batch_size
             V = self.model_cfg.vocab_size
-            self._counts_buf = jnp.zeros((S + 1, V), jnp.int32)
-            self._pmask_buf = jnp.zeros((S + 1, V), jnp.bool_)
+            if self._replicated is not None:
+                # born mesh-resident: the buffers thread through every
+                # sharded megastep as replicated in_shardings
+                # smglint: disable-next=RETRACE one-shot lazy buffer creation
+                zeros = jax.jit(
+                    lambda d: jnp.zeros((S + 1, V), d),
+                    static_argnums=0, out_shardings=self._replicated,
+                )
+                self._counts_buf = zeros(jnp.int32)
+                self._pmask_buf = zeros(jnp.bool_)
+            else:
+                self._counts_buf = jnp.zeros((S + 1, V), jnp.int32)
+                self._pmask_buf = jnp.zeros((S + 1, V), jnp.bool_)
 
     def penalty_state(
         self, prompt_ids: list[int], output_ids: list[int]
@@ -410,7 +477,12 @@ class ModelRunner:
             bank = {}
             for key in canonical_keys():
                 shape = (L, N) + weights[key].shape[1:]
-                bank[key] = jnp.zeros(shape, jnp.float32)
+                zeros = jnp.zeros(shape, jnp.float32)
+                if self._replicated is not None:
+                    # mesh-resident bank: the sharded step functions take it
+                    # as a replicated in_sharding every launch
+                    zeros = jax.device_put(zeros, self._replicated)
+                bank[key] = zeros
             self._lora_bank = bank
         if rank > self._lora_rank:
             raise ValueError(
@@ -458,8 +530,28 @@ class ModelRunner:
         if self._fold_in is None:
             self._fold_in = jax.jit(jax.random.fold_in)
         return self._fold_in(
-            self._rng_key, jax.device_put(np.uint32(self._step))
+            self._rng_key, self._scalar_up(np.uint32(self._step))
         )
+
+    def _scalar_up(self, x) -> jax.Array:
+        """Explicit scalar upload, mesh-committed when sharded (an
+        uncommitted scalar would be implicitly re-broadcast at every sharded
+        jit boundary — the transfer the steady-state guard forbids)."""
+        if self._replicated is not None:
+            return jax.device_put(x, self._replicated)
+        return jax.device_put(x)
+
+    def upload(self, x, dtype=None) -> jax.Array:
+        """Host array -> device-resident decode input, with the engine's
+        placement: replicated across the mesh under tp>1 (so the persistent
+        ``DecodeState`` buffers match the sharded step functions'
+        in_shardings exactly — zero per-launch resharding), the plain
+        default-device ``jnp.asarray`` otherwise (byte-identical to the
+        pre-sharded path)."""
+        if self._replicated is not None:
+            # smglint: disable-next=HOTSYNC host-side packing of a host array
+            return jax.device_put(np.asarray(x, dtype), self._replicated)
+        return jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype)
 
     def rng_mark(self) -> int:
         """Snapshot the sampling-key counter before a speculative (lookahead)
@@ -602,8 +694,9 @@ class ModelRunner:
                    + (1 if use_mrope else 0))
         # same CPU-PJRT caveat as decode_multi: a donated input makes CPU
         # dispatch synchronous, and this call exists precisely to stay async
-        # under an in-flight decode frame — skip donation there
-        donate = () if self._kv_donation_blocks_dispatch() else (5, 6)
+        # under an in-flight decode frame — the donation policy
+        # (engine/donation.py) skips donation there
+        donate = (5, 6) if self.donation.donate_kv else ()
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -734,36 +827,37 @@ class ModelRunner:
                                       use_lora=use_lora,
                                       use_embeds=use_embeds,
                                       use_mrope=use_mrope)
+        up = self.upload
         args = [
             self.params,
             self.inv_freq,
-            jnp.asarray(tokens),
-            jnp.asarray(prefix_lens),
-            jnp.asarray(t_reals),
+            up(tokens),
+            up(prefix_lens),
+            up(t_reals),
             self.k_cache,
             self.v_cache,
-            jnp.asarray(page_tables),
+            up(page_tables),
             self._next_key(),
-            jnp.asarray(ftemps),
-            jnp.asarray(ftopks),
-            jnp.asarray(ftopps),
-            jnp.asarray(fminps),
+            up(ftemps),
+            up(ftopks),
+            up(ftopps),
+            up(fminps),
         ]
         if pen is not None:
             counts, pmask, freqs, pres, reps = pen
             args += [
-                jnp.asarray(_pad_rows(counts, G).astype(np.int32)),
-                jnp.asarray(_pad_rows(pmask, G)),
-                jnp.asarray(_pad_vec(freqs, G, 0.0), jnp.float32),
-                jnp.asarray(_pad_vec(pres, G, 0.0), jnp.float32),
-                jnp.asarray(_pad_vec(reps, G, 1.0), jnp.float32),
+                up(_pad_rows(counts, G).astype(np.int32)),
+                up(_pad_rows(pmask, G)),
+                up(_pad_vec(freqs, G, 0.0), jnp.float32),
+                up(_pad_vec(pres, G, 0.0), jnp.float32),
+                up(_pad_vec(reps, G, 1.0), jnp.float32),
             ]
         if mask is not None:
-            args.append(jnp.asarray(_pad_rows(mask, G, fill=True)))
+            args.append(up(_pad_rows(mask, G, fill=True)))
         if use_lora:
             args += [
                 self._lora_bank,
-                jnp.asarray(_pad_vec(np.asarray(lora_idx, np.int32), G, 0)),
+                up(_pad_vec(np.asarray(lora_idx, np.int32), G, 0)),
             ]
         if use_embeds:
             E = next(m[0].shape[1] for m in mm if m is not None)
@@ -774,7 +868,7 @@ class ModelRunner:
                     d, bm = m
                     dense[i, : d.shape[0]] = d
                     emask[i, : bm.shape[0]] = bm
-            args += [jnp.asarray(dense), jnp.asarray(emask)]
+            args += [up(dense), up(emask)]
         if use_mrope:
             # default rows: all three axes = sequential position, which makes
             # apply_mrope EXACTLY apply_rope for the text rows in the group
@@ -784,7 +878,7 @@ class ModelRunner:
             for i, r in enumerate(rope):
                 if r is not None:
                     rp[i, :, : r.shape[1]] = r
-            args.append(jnp.asarray(rp))
+            args.append(up(rp))
         toks, lps, self.k_cache, self.v_cache = fn(*args)
         toks, lps = jax.device_get((toks, lps))  # intended blocking fetch
         return toks[:g_real], lps[:g_real]
@@ -837,6 +931,7 @@ class ModelRunner:
         KD = cfg.num_kv_heads * cfg.head_dim
         L = cfg.num_layers
         attn_impl = self._attn_impl_for(B, mp)
+        mesh, rules = self.mesh, self.rules
 
         n_slots = self.lora_slots
 
@@ -865,6 +960,12 @@ class ModelRunner:
             cache_dtype = kc.dtype
             hk0 = jnp.zeros((L, B, N, KD), cache_dtype)
             hv0 = jnp.zeros((L, B, N, KD), cache_dtype)
+            # align the horizon KV carry with the cache's lane sharding so
+            # the final scatter is shard-local — without the hint the SPMD
+            # partitioner is free to replicate the carry and all-gather at
+            # the scatter (layers/kv_lanes mirror kv_cache_logical_axes)
+            hk0 = shard_hint(hk0, ("layers", None, None, "kv_lanes"), mesh, rules)
+            hv0 = shard_hint(hv0, ("layers", None, None, "kv_lanes"), mesh, rules)
             counts0 = counts_buf[slot_idx] if use_pen else jnp.zeros((B, 0))
             pmask = pmask_buf[slot_idx] if use_pen else None
             sampler = _pick_sampler()
@@ -952,14 +1053,12 @@ class ModelRunner:
                    + (2 if use_lora else 0) + (1 if use_mrope else 0)
                    + (3 if use_stop else 0))
         # KV donation aliases the cache update in place — essential on TPU
-        # (cache is a large fraction of HBM).  The CPU backend, however,
-        # BLOCKS the dispatching thread for the whole execution when any
-        # input is donated (measured: donated jit call returns after compute;
-        # undonated returns in ~0.1ms), which would serialize the overlapped
-        # decode pipeline on the host thread.  CPU memory is not the scarce
-        # resource, so skip donation there and keep async dispatch.
+        # (cache is a large fraction of HBM), and under GSPMD each device
+        # aliases its local cache shard.  The per-backend/per-mode rules
+        # (CPU-PJRT blocks dispatch on donated inputs, which would serialize
+        # the overlapped pipeline) live in engine/donation.py.
         donate = (4, 5) + ((14,) if use_pen else ())
-        if self._kv_donation_blocks_dispatch():
+        if not self.donation.donate_kv:
             donate = ()
         if self.mesh is not None:
             r = self._replicated
@@ -1032,23 +1131,25 @@ class ModelRunner:
         # exactly _next_key's value at that global step
         mark = self._consume_folds(num_steps)
         # _dev: resident DecodeState buffers pass through (zero transfers in
-        # steady state); host inputs upload EXPLICITLY so the transfer guard
-        # can police this launch path
+        # steady state); host inputs upload EXPLICITLY — committed to the
+        # mesh when sharded — so the transfer guard can police this launch
+        # path
+        up = self._replicated
         args = [
             self.params,
             self.inv_freq,
-            _dev(tokens, jnp.int32),
-            _dev(positions, jnp.int32),
+            _dev(tokens, jnp.int32, up),
+            _dev(positions, jnp.int32, up),
             self.k_cache,
             self.v_cache,
-            _dev(page_tables, jnp.int32),
+            _dev(page_tables, jnp.int32, up),
             self._rng_key,
-            jax.device_put(np.uint32(mark)),
-            jax.device_put(np.int32(num_steps)),
-            _dev(temps, jnp.float32),
-            _dev(topks, jnp.int32),
-            _dev(topps, jnp.float32),
-            _dev(minps, jnp.float32),
+            self._scalar_up(np.uint32(mark)),
+            self._scalar_up(np.int32(num_steps)),
+            _dev(temps, jnp.float32, up),
+            _dev(topks, jnp.int32, up),
+            _dev(topps, jnp.float32, up),
+            _dev(minps, jnp.float32, up),
         ]
         if use_pen:
             self._ensure_penalty_buffers()
@@ -1056,23 +1157,23 @@ class ModelRunner:
             args += [
                 self._counts_buf,
                 self._pmask_buf,
-                _dev(slot_idx, jnp.int32),
-                _dev(freqs, jnp.float32),
-                _dev(pres, jnp.float32),
-                _dev(reps, jnp.float32),
+                _dev(slot_idx, jnp.int32, up),
+                _dev(freqs, jnp.float32, up),
+                _dev(pres, jnp.float32, up),
+                _dev(reps, jnp.float32, up),
             ]
         if use_mask:
-            args.append(_dev(mask, jnp.bool_))
+            args.append(_dev(mask, jnp.bool_, up))
         if use_lora:
-            args += [self._lora_bank, _dev(lora_idx, jnp.int32)]
+            args += [self._lora_bank, _dev(lora_idx, jnp.int32, up)]
         if use_mrope:
-            args.append(_dev(rope_delta, jnp.int32))
+            args.append(_dev(rope_delta, jnp.int32, up))
         if E:
             stop_ids, limits, live = stop_state
             args += [
-                _dev(stop_ids, jnp.int32),
-                _dev(limits, jnp.int32),
-                _dev(live, jnp.bool_),
+                _dev(stop_ids, jnp.int32, up),
+                _dev(limits, jnp.int32, up),
+                _dev(live, jnp.bool_, up),
             ]
         out = fn(*args)
         if use_pen:
@@ -1195,30 +1296,31 @@ class ModelRunner:
         )
         if rope_pos is not None and use_ring:
             raise ValueError("M-RoPE does not compose with ring prefill yet")
+        up = self.upload  # mesh-replicated commit under tp>1; jnp.asarray else
         base_args = [
             self.params,
             self.inv_freq,
-            jnp.asarray(tokens),
-            jnp.int32(prefix_len),
-            jnp.int32(t),
+            up(tokens),
+            up(prefix_len, jnp.int32),
+            up(t, jnp.int32),
             self.k_cache,
             self.v_cache,
-            jnp.asarray(page_table, jnp.int32),
+            up(page_table, jnp.int32),
         ]
         tail_args = []
         if use_lora:
-            tail_args += [self._lora_bank, jnp.int32(lora_idx)]
+            tail_args += [self._lora_bank, up(lora_idx, jnp.int32)]
         if mm is not None:
             embeds, emask = mm
             pe = np.zeros((T, embeds.shape[1]), np.float32)
             pe[:t] = embeds
             pm = np.zeros(T, bool)
             pm[:t] = emask
-            tail_args += [jnp.asarray(pe), jnp.asarray(pm)]
+            tail_args += [up(pe), up(pm)]
         if rope_pos is not None:
             rp = np.zeros((3, T), np.int32)
             rp[:, :t] = rope_pos
-            tail_args.append(jnp.asarray(rp))
+            tail_args.append(up(rp))
         return T, mp, base_args, use_lora, use_ring, tail_args
 
     def prefill(
@@ -1245,24 +1347,25 @@ class ModelRunner:
                               use_mask=mask is not None, use_lora=use_lora,
                               use_ring=use_ring, use_embeds=mm is not None,
                               use_mrope=rope_pos is not None)
+        up = self.upload
         args = base_args + [
             self._next_key(),
-            jnp.asarray([temperature], jnp.float32),
-            jnp.asarray([top_k], jnp.int32),
-            jnp.asarray([top_p], jnp.float32),
-            jnp.asarray([min_p], jnp.float32),
+            up([temperature], jnp.float32),
+            up([top_k], jnp.int32),
+            up([top_p], jnp.float32),
+            up([min_p], jnp.float32),
         ]
         if pen is not None:
             counts, pmask, freq, pres, rep = pen
             args += [
-                jnp.asarray(counts, jnp.int32)[None],
-                jnp.asarray(pmask)[None],
-                jnp.asarray([freq], jnp.float32),
-                jnp.asarray([pres], jnp.float32),
-                jnp.asarray([rep], jnp.float32),
+                up(counts, jnp.int32)[None],
+                up(pmask)[None],
+                up([freq], jnp.float32),
+                up([pres], jnp.float32),
+                up([rep], jnp.float32),
             ]
         if mask is not None:
-            args.append(jnp.asarray(mask)[None])
+            args.append(up(mask)[None])
         args += tail_args
         tok, lp, self.k_cache, self.v_cache = fn(*args)
         return int(tok), float(lp)
@@ -1337,6 +1440,12 @@ class ModelRunner:
                 params, cfg, inv_freq, tokens, entry_pos, kc, vc, page_tables,
                 rope_delta=rope_delta,
             )  # [B, W, V], [L, B, W, KD] x2
+            # same lane-sharding hint as the megastep's horizon carry: keep
+            # the accepted-column scatter shard-local against the kv cache
+            bk = shard_hint(bk, ("layers", None, None, "kv_lanes"),
+                            self.mesh, self.rules)
+            bv = shard_hint(bv, ("layers", None, None, "kv_lanes"),
+                            self.mesh, self.rules)
             props = tokens[:, 1:]  # [B, W-1] drafted columns
             greedy = temps <= 0.0
             # greedy chain: accept while draft matches the running argmax
@@ -1397,7 +1506,7 @@ class ModelRunner:
             ).reshape(vc.shape)
             return emitted, n_emit, lps, kc, vc
 
-        donate = () if self._kv_donation_blocks_dispatch() else (5, 6)
+        donate = (5, 6) if self.donation.donate_kv else ()
         if self.mesh is not None:
             r = self._replicated
             in_sh = (self.param_shardings, r, r, r, r,
@@ -1437,24 +1546,25 @@ class ModelRunner:
         use_mrope = rope_delta is not None
         fn = self._decode_spec_fn(B, mp, W, use_mrope)
         mark = self._consume_folds(1)
+        up = self._replicated
         args = [
             self.params,
             self.inv_freq,
-            _dev(tokens, jnp.int32),
-            _dev(draft_n, jnp.int32),
-            _dev(positions, jnp.int32),
+            _dev(tokens, jnp.int32, up),
+            _dev(draft_n, jnp.int32, up),
+            _dev(positions, jnp.int32, up),
             self.k_cache,
             self.v_cache,
-            _dev(page_tables, jnp.int32),
+            _dev(page_tables, jnp.int32, up),
             self._rng_key,
-            jax.device_put(np.uint32(mark)),
-            _dev(temps, jnp.float32),
-            _dev(topks, jnp.int32),
-            _dev(topps, jnp.float32),
-            _dev(minps, jnp.float32),
+            self._scalar_up(np.uint32(mark)),
+            _dev(temps, jnp.float32, up),
+            _dev(topks, jnp.int32, up),
+            _dev(topps, jnp.float32, up),
+            _dev(minps, jnp.float32, up),
         ]
         if use_mrope:
-            args.append(_dev(rope_delta, jnp.int32))
+            args.append(_dev(rope_delta, jnp.int32, up))
         emitted, n_emit, lps, self.k_cache, self.v_cache = fn(*args)
         return emitted, n_emit, lps
 

@@ -222,6 +222,19 @@ class Scheduler:
                 ),
             )
             self.flight.metrics = metrics
+        # sharded-dispatch accounting: host seconds spent ENQUEUEING device
+        # launches (async dispatch of the sharded/single-device programs)
+        # vs BLOCKED on the deferred fetch — the split that shows whether a
+        # mesh's extra dispatch work (sharded arg binding, per-device
+        # buffers) is eating the megastep's host-amortization win.  Step-
+        # scoped for the ring + metrics, cumulative for benches.
+        self._step_dispatch_s = 0.0
+        self.dispatch_enqueue_s_total = 0.0
+        self.fetch_wait_s_total = 0.0
+        # mesh device count riding every flight-ring record (1 = single
+        # -device): postmortems from a mixed fleet self-describe their
+        # topology; runner.mesh_devices is the single source
+        self._mesh_devices = runner.mesh_devices
         # step-scoped recorder state (reset at the top of every step)
         self._step_fault_phases: list[str] = []
         self._step_admissions = 0
@@ -319,6 +332,13 @@ class Scheduler:
             or self.inflight is not None
         )
 
+    def _note_dispatch(self, seconds: float) -> None:
+        """Account one async device-launch enqueue (megastep, chained
+        lookahead, or spec verify block): step-scoped for the flight ring /
+        metrics split, cumulative for the tp-scaling bench."""
+        self._step_dispatch_s += seconds
+        self.dispatch_enqueue_s_total += seconds
+
     def prefill_inflight_tokens(self) -> int:
         """Un-prefilled prompt tokens of admitted, in-progress (resumable)
         prefills — the slot-holding half of the prefill backlog."""
@@ -393,6 +413,13 @@ class Scheduler:
             "deadline_expirations_waiting": self.num_deadline_waiting,
             "deadline_expirations_running": self.num_deadline_running,
             "draining": self.draining,
+            # sharded runner mode: mesh topology (devices / per-axis shape /
+            # platform / donation verdict) + the dispatch-vs-fetch host-time
+            # split, so operators can see a TP worker's sharding from
+            # /scheduler without reaching into the runner
+            "mesh": self.runner.mesh_info(),
+            "dispatch_enqueue_seconds": self.dispatch_enqueue_s_total,
+            "fetch_wait_seconds": self.fetch_wait_s_total,
         }
         if self.metrics is not None:
             # rolling-window live signal (p50/p95 step time, tokens/s) for
@@ -480,6 +507,7 @@ class Scheduler:
         self._step_admissions = 0
         self._step_outcome = None
         self._step_fetch_s = 0.0
+        self._step_dispatch_s = 0.0
         self._step_horizon = 0
         self._step_spec_drafted = 0
         self._step_spec_accepted = 0
@@ -524,6 +552,7 @@ class Scheduler:
                     wasted_decode_tokens=self.num_wasted_decode_tokens - we0,
                     spec_drafted=self._step_spec_drafted,
                     spec_accepted=self._step_spec_accepted,
+                    mesh=self._mesh_devices,
                 )
                 self.flush_pending_dumps()
         return outputs
@@ -553,11 +582,16 @@ class Scheduler:
         overlap = self.sched.overlap_schedule and not spec_mode
         if overlap:
             admit_s, fetch_s, outcome = self._step_overlap(outputs)
-            # stash for the step's flight-recorder ring record
-            self._step_outcome, self._step_fetch_s = outcome, fetch_s
+            # stash for the step's flight-recorder ring record (+=: the
+            # accumulator is reset at the top of each step, and sub-phases
+            # like the spec rest-megastep may already have deposited fetch
+            # time — overwriting would undercount the dispatch split)
+            self._step_outcome = outcome
+            self._step_fetch_s += fetch_s
         elif spec_mode and self.sched.overlap_schedule:
             admit_s, fetch_s, outcome = self._step_spec(outputs)
-            self._step_outcome, self._step_fetch_s = outcome, fetch_s
+            self._step_outcome = outcome
+            self._step_fetch_s += fetch_s
         else:
             self.drop_inflight()  # mode flip mid-run: never strand a frame
             self._admit(outputs)
@@ -596,6 +630,13 @@ class Scheduler:
                     outcome=outcome,
                     fetch_wait_s=fetch_s,
                     host_s=max(step_s - fetch_s, 0.0),
+                )
+            if self._step_dispatch_s or self._step_fetch_s:
+                # sharded-dispatch split: host time enqueueing the (mesh or
+                # single-device) programs vs blocked on the deferred fetch
+                m.observe_dispatch(
+                    enqueue_s=self._step_dispatch_s,
+                    fetch_s=self._step_fetch_s,
                 )
 
     # ---- failure isolation (poison-step quarantine) ----
@@ -1045,6 +1086,7 @@ class Scheduler:
             (frame.toks, frame.lps, frame.steps_run)
         )
         fetch_s = time.perf_counter() - t0
+        self.fetch_wait_s_total += fetch_s
         if frame.lookahead:
             self.num_lookahead_kept += 1
         sr = int(steps_run) if steps_run is not None else frame.horizon
@@ -1147,6 +1189,7 @@ class Scheduler:
             frame.use_pen, frame.use_lora, frame.use_mrope, frame.lane_sig,
         )
         mark = self.runner.rng_mark()
+        t_dispatch = time.perf_counter()
         # the chained input column comes off the in-flight frame with a
         # STATIC lax slice: `frame.toks[:, -1]` would route the index through
         # eager dispatch as a scalar operand — an implicit host→device
@@ -1164,6 +1207,7 @@ class Scheduler:
             lora_idx=ds.lora_idx if frame.use_lora else None,
             rope_delta=ds.rope_delta if frame.use_mrope else None,
         )
+        self._note_dispatch(time.perf_counter() - t_dispatch)
         return InFlightFrame(
             lanes=[(s, r, e + H) for s, r, e in frame.lanes],
             toks=toks, lps=lps, horizon=H2, B=frame.B, B_real=frame.B_real,
@@ -1749,6 +1793,7 @@ class Scheduler:
         if frame is not None:
             try:
                 _fetch_s, used = self._consume_frame(frame, outputs)
+                self._step_fetch_s += _fetch_s
             except Exception:
                 # stash so the quarantine handler's drop_inflight rewinds
                 # this frame's sampling-key folds before any retry refolds
@@ -1776,6 +1821,10 @@ class Scheduler:
         of ~10 host->device uploads per step."""
         ds = self._dstate
         S = self.sched.max_batch_size  # runner's garbage penalty-state row
+        # placement-aware upload: mesh-replicated commit under tp>1 (the
+        # sharded jits' in_shardings match exactly — no per-launch reshard),
+        # plain jnp.asarray on single-device engines
+        up = self.runner.upload
         if ds.lane_sig != sig:
             temps = np.zeros(B, np.float32)
             topks = np.full(B, -1, np.int32)
@@ -1803,17 +1852,17 @@ class Scheduler:
                     rope_delta[idx] = req.mrope_delta
                 if use_lora:
                     lora_idx[idx] = req.lora_idx
-            ds.temps = jnp.asarray(temps)
-            ds.topks = jnp.asarray(topks)
-            ds.topps = jnp.asarray(topps)
-            ds.minps = jnp.asarray(minps)
+            ds.temps = up(temps)
+            ds.topks = up(topks)
+            ds.topps = up(topps)
+            ds.minps = up(minps)
             if use_pen:
-                ds.slot_idx = jnp.asarray(slot_idx)
-                ds.freqs = jnp.asarray(freqs)
-                ds.pres = jnp.asarray(pres)
-                ds.reps = jnp.asarray(reps)
-            ds.lora_idx = jnp.asarray(lora_idx) if use_lora else None
-            ds.rope_delta = jnp.asarray(rope_delta) if use_mrope else None
+                ds.slot_idx = up(slot_idx)
+                ds.freqs = up(freqs)
+                ds.pres = up(pres)
+                ds.reps = up(reps)
+            ds.lora_idx = up(lora_idx) if use_lora else None
+            ds.rope_delta = up(rope_delta) if use_mrope else None
             if stop_e > 0:
                 # megastep device stop state: one upload per composition.
                 # stop_ids [B, E] (-1 padded; tokens are always >= 0 so the
@@ -1834,9 +1883,9 @@ class Scheduler:
                         self.sched.max_seq_len,
                     )
                     live[idx] = True
-                ds.stop_ids = jnp.asarray(stop_ids)
-                ds.limits = jnp.asarray(limits)
-                ds.live = jnp.asarray(live)
+                ds.stop_ids = up(stop_ids)
+                ds.limits = up(limits)
+                ds.live = up(live)
             else:
                 ds.stop_ids = ds.limits = ds.live = None
             ds.lane_sig = sig
@@ -1855,7 +1904,7 @@ class Scheduler:
             page_tables = np.zeros((B, mp_b), np.int32)
             for idx, (slot, _req) in enumerate(active):
                 page_tables[idx] = self.page_tables[slot][:mp_b]
-            ds.page_tables = jnp.asarray(page_tables)
+            ds.page_tables = up(page_tables)
             ds.pt_sig = pt_sig
             self._pages_dirty = False
         return ds
@@ -2024,6 +2073,7 @@ class Scheduler:
             positions[idx] = mp_b * self.ps
 
         mark = self.runner.rng_mark()
+        t_dispatch = time.perf_counter()
         toks, lps, steps_run = self.runner.decode_multi_async(
             tokens, positions, ds.page_tables,
             ds.temps, ds.topks, ds.topps, ds.minps, horizon,
@@ -2035,6 +2085,7 @@ class Scheduler:
             lora_idx=ds.lora_idx if use_lora else None,
             rope_delta=ds.rope_delta if use_mrope else None,
         )
+        self._note_dispatch(time.perf_counter() - t_dispatch)
         return InFlightFrame(
             lanes=[(i, r, r.seq_len) for i, r in active],
             toks=toks, lps=lps, horizon=horizon, B=B, B_real=B_real,
@@ -2223,7 +2274,7 @@ class Scheduler:
             self.inflight = frame
         else:
             try:
-                self._consume_spec_frame(frame, outputs)
+                self._step_fetch_s += self._consume_spec_frame(frame, outputs)
             except Exception:
                 # stash: the quarantine handler's drop_inflight rewinds the
                 # launch fold before any retry refolds
@@ -2297,11 +2348,13 @@ class Scheduler:
             # the garbage page, and the all-zero page-table row is inert
             positions[idx] = mp_b * self.ps
         mark = self.runner.rng_mark()
+        t_dispatch = time.perf_counter()
         emitted, n_emit, lps = self.runner.decode_spec_async(
             tokens, draft_n, positions, page_tables,
             temps, topks, topps, minps,
             rope_delta=rope_delta,
         )
+        self._note_dispatch(time.perf_counter() - t_dispatch)
         return InFlightFrame(
             lanes=[(s, r, r.seq_len) for s, r in lanes],
             toks=emitted, lps=lps, horizon=W, B=B, B_real=B_real,
@@ -2350,6 +2403,7 @@ class Scheduler:
             (frame.toks, frame.lps, frame.n_emit)
         )
         fetch_s = time.perf_counter() - t0
+        self.fetch_wait_s_total += fetch_s
         if frame.lookahead:
             self.num_lookahead_kept += 1
         m = self.metrics

@@ -55,15 +55,35 @@ def build_engine_from_args(args):
     elif getattr(args, "draft_model_preset", None):
         draft_model = PRESETS[args.draft_model_preset]()
 
+    parallel = ParallelConfig(
+        dp=args.dp, tp=args.tp,
+        pp=getattr(args, "pp", 1), sp=getattr(args, "sp", 1),
+        ep=getattr(args, "ep", 1),
+    )
+    if getattr(args, "mesh_shape", None):
+        # --mesh-shape names the topology in one string; validate_cli_args
+        # already rejected conflicts with differing per-axis flags
+        parallel = ParallelConfig.from_spec(args.mesh_shape, base=parallel)
+    if parallel.world_size > 1:
+        import jax
+
+        n_dev = len(jax.devices())
+        if n_dev < parallel.world_size:
+            raise SystemExit(
+                f"mesh {parallel.axis_sizes()} needs {parallel.world_size} "
+                f"devices, found {n_dev} (CPU dryruns: set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N)"
+            )
+        logger.info(
+            "parallel mesh: %s over %d devices",
+            parallel.axis_sizes(), parallel.world_size,
+        )
+
     cfg = EngineConfig(
         model=model,
         model_path=args.model_path,
         tokenizer_path=args.tokenizer_path or args.model_path,
-        parallel=ParallelConfig(
-            dp=args.dp, tp=args.tp,
-            pp=getattr(args, "pp", 1), sp=getattr(args, "sp", 1),
-            ep=getattr(args, "ep", 1),
-        ),
+        parallel=parallel,
         cache=CacheConfig(
             page_size=args.page_size,
             # KV follows the compute dtype unless the operator overrides

@@ -52,16 +52,66 @@ def logical_to_spec(logical_axes: tuple[str | None, ...], rules: ShardingRules) 
     return P(*rules.mesh_axes(logical_axes))
 
 
+def _divisible_axes(
+    mesh_axes: tuple[str | None, ...], mesh: Mesh, shape
+) -> tuple[str | None, ...]:
+    """Drop (replicate) mesh axes that do not divide the corresponding dim.
+
+    The rules table is model-agnostic, but real tensors aren't: a GQA model
+    with 2 kv heads cannot shard ``kv_heads`` 4-ways, and XLA rejects the
+    sharding at trace time with a divisibility error.  Replicating just the
+    offending axis keeps every OTHER dim sharded (the matmul-heavy q/ffn/
+    vocab axes still split), which is the standard degrade for small-model /
+    large-mesh combinations."""
+    return tuple(
+        a if (a is None or shape[i] % mesh.shape.get(a, 1) == 0) else None
+        for i, a in enumerate(mesh_axes)
+    )
+
+
 def logical_to_sharding(
-    logical_axes: tuple[str | None, ...], mesh: Mesh, rules: ShardingRules
+    logical_axes: tuple[str | None, ...],
+    mesh: Mesh,
+    rules: ShardingRules,
+    shape: "tuple[int, ...] | None" = None,
 ) -> NamedSharding:
-    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+    """``shape`` (optional) arms the divisibility fallback: any mesh axis
+    that does not divide its dim is replicated instead of erroring."""
+    axes = rules.mesh_axes(logical_axes)
+    if shape is not None:
+        axes = _divisible_axes(axes, mesh, shape)
+    return NamedSharding(mesh, P(*axes))
 
 
-def tree_shardings(logical_tree, mesh: Mesh, rules: ShardingRules):
-    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+def tree_shardings(logical_tree, mesh: Mesh, rules: ShardingRules, shapes=None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+
+    ``shapes`` (optional) is a matching pytree of arrays / ShapeDtypeStructs;
+    when given, each leaf's sharding drops mesh axes that don't divide the
+    actual dim (see ``_divisible_axes``) instead of failing at trace time.
+    """
+    is_axes = lambda x: isinstance(x, tuple) and all(  # noqa: E731
+        isinstance(a, (str, type(None))) for a in x
+    )
+    if shapes is None:
+        return jax.tree.map(
+            lambda axes: logical_to_sharding(axes, mesh, rules),
+            logical_tree, is_leaf=is_axes,
+        )
     return jax.tree.map(
-        lambda axes: logical_to_sharding(axes, mesh, rules),
-        logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+        lambda axes, arr: logical_to_sharding(axes, mesh, rules, shape=arr.shape),
+        logical_tree, shapes, is_leaf=is_axes,
+    )
+
+
+def shard_hint(x, logical_axes: tuple[str | None, ...], mesh, rules: ShardingRules):
+    """In-jit sharding constraint with the same divisibility fallback —
+    ``jax.lax.with_sharding_constraint`` where the SPMD partitioner needs
+    help (e.g. aligning the megastep's horizon KV buffers with the sharded
+    cache so the in-loop scatter stays local).  No-op when ``mesh`` is None,
+    so single-device traces are untouched."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, logical_to_sharding(logical_axes, mesh, rules, shape=x.shape)
     )

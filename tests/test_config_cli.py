@@ -237,3 +237,53 @@ def test_tenant_trust_is_per_context():
     override = AppContext(policy="round_robin", trust_tenant_header=True,
                           auth_config=AuthConfig(enabled=True))
     assert override.trust_tenant_header is True
+
+
+# ---- tensor-parallel mesh flags (--tensor-parallel-size / --mesh-shape) ----
+
+
+def _serve(*extra):
+    return build_parser().parse_args(
+        ["serve", "--model-preset", "tiny", *extra]
+    )
+
+
+def test_tensor_parallel_size_alias():
+    """--tensor-parallel-size is the same flag as --tp (reference naming)."""
+    assert _serve("--tensor-parallel-size", "4").tp == 4
+    assert _serve("--tp", "4").tp == 4
+
+
+def test_mesh_shape_parses_over_base():
+    from smg_tpu.engine.config import ParallelConfig
+
+    p = ParallelConfig.from_spec("dp=2,tp=4")
+    assert (p.dp, p.tp, p.sp, p.ep, p.pp) == (2, 4, 1, 1, 1)
+    assert p.world_size == 8
+    # base values survive for unnamed axes
+    p2 = ParallelConfig.from_spec("tp=2", base=ParallelConfig(pp=2))
+    assert (p2.tp, p2.pp) == (2, 2)
+
+
+@pytest.mark.parametrize("bad", ["xx=2", "tp", "tp=zero", "tp=0", "tp=-1",
+                                 "tp=2,tp=4"])
+def test_mesh_shape_rejects_malformed(bad):
+    from smg_tpu.engine.config import ParallelConfig
+
+    with pytest.raises(ValueError):
+        ParallelConfig.from_spec(bad)
+
+
+def test_mesh_shape_flag_conflict_is_error():
+    # conflicting axis sizes between --mesh-shape and a per-axis flag
+    bad = _serve("--mesh-shape", "tp=4", "--tp", "2")
+    assert any("mesh_shape" in i.field for i in _errors(bad))
+    # agreement (or the per-axis flag left at its default) is fine
+    assert _errors(_serve("--mesh-shape", "tp=4", "--tp", "4")) == []
+    assert _errors(_serve("--mesh-shape", "dp=2,tp=4")) == []
+    # axes the spec does NOT name merge from the per-axis flags at launch —
+    # never a conflict
+    assert _errors(_serve("--mesh-shape", "tp=4", "--dp", "2")) == []
+    # malformed string surfaces as a startup error, not a trace-time one
+    assert any("mesh_shape" in i.field
+               for i in _errors(_serve("--mesh-shape", "bogus=2")))
