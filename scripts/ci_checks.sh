@@ -5,12 +5,27 @@
 #                       (HOTSYNC / ASYNCBLOCK / LOCKAWAIT / RETRACE plus the
 #                       smglint-v2 concurrency set: GUARDED lock-discipline
 #                       inference, FRAMEFOLD frame/fold lifecycle, LOCKORDER
-#                       acquisition-order inversions — all in the default
-#                       set), failing on any unbaselined finding;
+#                       acquisition-order inversions, and the smglint-v3
+#                       JAX-discipline set: TRACEPURE tracer purity, DONATE
+#                       use-after-donate, SHARDDISC sharding commitment —
+#                       all in the default set), failing on any unbaselined
+#                       finding.  A --changed fast path vs the merge base
+#                       runs first for quick signal on PR branches; the full
+#                       sweep that follows is the authoritative gate
+#                       (cross-module rules like LOCKORDER need it);
 #   2. metric docs    — README observability table vs exported smg_* series;
 #   3. runtime guards — transfer-guard + zero-recompile probes on the real
 #                       engine's steady-state decode loop (the runtime teeth
 #                       behind HOTSYNC/RETRACE), via tests/test_analysis.py;
+#   3b. program audit — compiled-program auditor on the runner's cached jit
+#                       families (the runtime teeth behind TRACEPURE/DONATE/
+#                       SHARDDISC): steady-state audited-clean at tp=1 and
+#                       tp=8 (0 uncommitted inputs, 0 sharding mismatches,
+#                       every intended donation verified-aliased in the
+#                       compiled HLO, 0 recompiles while armed), a
+#                       deliberately-uncommitted input caught, and recompile
+#                       provenance naming the offending argument
+#                       (TestProgramAudit in tests/test_analysis.py);
 #   4. chunked-prefill scheduling — budgeted-vs-legacy and overlap/sync
 #                       stream parity under the per-step prefill budget,
 #                       plus mid-prefill preemption/abort lifecycle
@@ -77,7 +92,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== smglint =="
+echo "== smglint (--changed fast path) =="
+# Quick signal on the changed subset first; vs the merge base when an
+# upstream main exists, else vs HEAD (working tree + untracked).  The full
+# sweep below stays the authoritative gate — cross-module rules (LOCKORDER)
+# only see pairs inside the changed subset here.
+MERGE_BASE=$(git merge-base HEAD origin/main 2>/dev/null \
+    || git merge-base HEAD main 2>/dev/null || echo HEAD)
+python scripts/smglint.py --changed "$MERGE_BASE"
+
+echo "== smglint (full sweep — authoritative) =="
 python scripts/smglint.py smg_tpu/
 
 echo "== metric docs drift =="
@@ -85,7 +109,11 @@ JAX_PLATFORMS=cpu python scripts/check_metric_docs.py
 
 echo "== lint rule suite + runtime guard probes =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
-    -p no:cacheprovider
+    -k 'not TestProgramAudit' -p no:cacheprovider
+
+echo "== program audit (compiled-program auditor, tp=1 + tp=8) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
+    -k TestProgramAudit -p no:cacheprovider
 
 echo "== chunked-prefill scheduling parity =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chunked_prefill.py \

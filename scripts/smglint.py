@@ -1,11 +1,14 @@
 #!/usr/bin/env python
 """Repo-native static analysis: hot-path sync, async-blocking, lock-domain,
 jit-retrace, lock-discipline (GUARDED), frame/fold lifecycle (FRAMEFOLD),
-and lock-order inversion (LOCKORDER) hazards.  Thin wrapper so CI can run it
-without installing the package; the implementation lives in
-``smg_tpu/analysis/``.
+lock-order inversion (LOCKORDER), and JAX-discipline (TRACEPURE tracer
+purity, DONATE use-after-donate, SHARDDISC sharding commitment) hazards.
+Thin wrapper so CI can run it without installing the package; the
+implementation lives in ``smg_tpu/analysis/``.
 
     python scripts/smglint.py smg_tpu/
+    python scripts/smglint.py --changed              # pre-commit fast path
+    python scripts/smglint.py --changed origin/main  # vs a merge base
     python scripts/smglint.py smg_tpu/ --write-baseline
     python scripts/smglint.py smg_tpu/gateway --rules GUARDED,LOCKORDER
     python scripts/smglint.py smg_tpu/ --format sarif   # CI diff annotation

@@ -10,18 +10,28 @@ docs to the exported series:
 
 - an AST rule engine (``core``) with per-line ``# smglint: disable=RULE``
   suppressions and a checked-in baseline for grandfathered findings;
-- seven rule families (``rules``): HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE,
+- ten rule families (``rules``): HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE,
   plus the concurrency/lifecycle set — GUARDED (lock-discipline inference:
   fields written under a lock must not be accessed lock-free), FRAMEFOLD
   (every frame launch accounts for its sampling-key folds on every path,
   exception edges included), LOCKORDER (nested lock acquisitions keep one
-  global order across the whole run);
+  global order across the whole run) — plus the JAX-discipline set —
+  TRACEPURE (no host side effects, wall-clock/RNG reads, or Python
+  branching on traced values inside traced functions), DONATE (a donated
+  buffer is never read again without rebinding, and donation positions
+  exist on the callee), SHARDDISC (mesh modules commit their uploads and
+  loop carries to an explicit sharding instead of resharding per launch);
 - runtime guards (``runtime_guards``) pairing the static pass with
   ``jax.transfer_guard`` + XLA-compile counting around the steady-state
-  decode loop, and a lockdep-style :func:`lock_order_sentinel` whose
+  decode loop, a lockdep-style :func:`lock_order_sentinel` whose
   :func:`make_lock` wrapper the engine/recorder/gateway locks adopt —
   armed via the context manager or ``SMG_LOCK_SENTINEL=1``, any dynamic
-  lock-order inversion fails the suite with both acquisition stacks.
+  lock-order inversion fails the suite with both acquisition stacks —
+  and the :class:`ProgramAuditor` / :func:`program_audit` compiled-program
+  audit: the runner's cached jit families, armed after warmup, must show
+  committed inputs matching their declared shardings, every intended
+  donation aliased in the compiled HLO, and recompile provenance naming
+  the argument whose shape/dtype/sharding changed.
 
 Lint-only use (``scripts/smglint.py`` / the ``smglint`` console script) has
 no jax dependency; ``runtime_guards`` imports jax lazily.
@@ -36,13 +46,16 @@ from smg_tpu.analysis.core import (
     load_baseline,
     write_baseline,
 )
+from smg_tpu.analysis.runtime_guards import ProgramAuditor, program_audit
 
 __all__ = [
     "Finding",
     "LintConfig",
+    "ProgramAuditor",
     "apply_baseline",
     "lint_paths",
     "lint_source",
     "load_baseline",
+    "program_audit",
     "write_baseline",
 ]

@@ -5,6 +5,12 @@ Exit status: 0 = clean (every finding suppressed or baselined), 1 = new
 findings, 2 = usage error.  ``--write-baseline`` grandfathers the current
 findings; CI then fails only on NEW ones, and the baseline file's diff is
 the reviewable record of debt.
+
+``--changed [REF]`` (default HEAD) lints only the Python files changed vs
+REF plus untracked files — the pre-commit fast path
+(``python scripts/smglint.py --changed``).  Same exit codes, suppressions
+and baseline; only the target set shrinks, so cross-module rules
+(LOCKORDER) see less — the full sweep remains the authoritative CI gate.
 """
 
 from __future__ import annotations
@@ -89,6 +95,50 @@ def to_sarif(findings) -> dict:
     }
 
 
+def _changed_py_files(ref: str, scope_paths: list[str]) -> list[Path]:
+    """Python files changed vs ``ref`` (``git diff`` + untracked), repo-wide
+    or narrowed to ``scope_paths`` when given.  Deleted files drop out (they
+    no longer exist); rename targets appear as untracked/modified.  Raises
+    OSError outside a git work tree so the caller exits 2 — a silent empty
+    set would pass the gate while checking nothing."""
+    import subprocess
+
+    from smg_tpu.analysis.core import _repo_root, scope_prefixes
+
+    root = _repo_root(Path(scope_paths[0] if scope_paths else ".").resolve())
+    if root is None:
+        raise OSError("--changed needs a repo root (pyproject.toml) above "
+                      "the target paths")
+
+    def git(*args: str) -> list[str]:
+        proc = subprocess.run(
+            ["git", "-C", str(root), *args],
+            capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise OSError(
+                f"git {' '.join(args)} failed: {proc.stderr.strip()}"
+            )
+        return [ln for ln in proc.stdout.splitlines() if ln.strip()]
+
+    names = set(git("diff", "--name-only", ref, "--"))
+    names |= set(git("ls-files", "--others", "--exclude-standard", "--"))
+    prefixes = scope_prefixes(scope_paths) if scope_paths else None
+    out: list[Path] = []
+    for rel in sorted(names):
+        if not rel.endswith(".py"):
+            continue
+        if prefixes is not None and not any(
+            rel == pre or (pre.endswith("/") and rel.startswith(pre))
+            for pre in prefixes
+        ):
+            continue
+        abspath = root / rel
+        if abspath.is_file():
+            out.append(abspath)
+    return out
+
+
 def _default_baseline_path(paths: list[str]) -> Path | None:
     """The checked-in baseline next to pyproject.toml, when one exists."""
     from smg_tpu.analysis.core import _repo_root
@@ -103,11 +153,23 @@ def _default_baseline_path(paths: list[str]) -> Path | None:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="smglint",
-        description="AST hot-path & concurrency lint for smg-tpu "
-                    "(HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE, GUARDED, "
-                    "FRAMEFOLD, LOCKORDER)",
+        description="AST hot-path, concurrency & JAX-discipline lint for "
+                    "smg-tpu (HOTSYNC, ASYNCBLOCK, LOCKAWAIT, RETRACE, "
+                    "GUARDED, FRAMEFOLD, LOCKORDER, TRACEPURE, DONATE, "
+                    "SHARDDISC)",
     )
-    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint (optional with "
+                         "--changed: the scope narrows the changed set)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only Python files changed vs REF (default "
+                         "HEAD: working tree + untracked) — the pre-commit "
+                         "fast path; exit codes, suppressions and baseline "
+                         "handling are identical to a full run, but "
+                         "cross-module rules (LOCKORDER) only see the "
+                         "changed subset, so the full sweep stays the "
+                         "authoritative CI gate")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline JSON (default: {DEFAULT_BASELINE} at the "
                          "repo root, when present)")
@@ -124,12 +186,31 @@ def main(argv: list[str] | None = None) -> int:
                     help="also list suppressed and baselined findings")
     args = ap.parse_args(argv)
 
+    if not args.paths and args.changed is None:
+        ap.print_usage(sys.stderr)
+        print("smglint: error: paths required (or use --changed)",
+              file=sys.stderr)
+        return 2
+    if args.changed is not None and args.write_baseline:
+        print("smglint: error: --write-baseline needs the full-scope run, "
+              "not --changed (a changed-subset baseline would silently drop "
+              "entries for untouched files)", file=sys.stderr)
+        return 2
+
     rules = None
     if args.rules:
         rules = tuple(r.strip().upper() for r in args.rules.split(",") if r.strip())
     try:
         config = LintConfig(rules=rules)
-        findings = lint_paths(args.paths, config)
+        if args.changed is not None:
+            targets = _changed_py_files(args.changed, args.paths)
+            if not targets:
+                print(f"smglint: ok — no Python files changed vs "
+                      f"{args.changed}")
+                return 0
+        else:
+            targets = args.paths
+        findings = lint_paths(targets, config)
     except (KeyError, OSError) as e:
         print(f"smglint: {e}", file=sys.stderr)
         return 2

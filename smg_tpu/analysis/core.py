@@ -82,6 +82,18 @@ class LintConfig:
         "smg_tpu/engine/sampling.py",
         "smg_tpu/ops/*",
     )
+    # modules that participate in sharded (tp>1) decode and must route every
+    # device upload through the committed-sharding helpers (SHARDDISC).
+    # Deliberately NOT parallel/pipeline/ring modules: inside shard_map the
+    # per-device view is manual and with_sharding_constraint is wrong there.
+    shard_paths: tuple[str, ...] = (
+        "smg_tpu/engine/runner.py",
+        "smg_tpu/engine/scheduler.py",
+        "smg_tpu/engine/kv_cache.py",
+        "smg_tpu/engine/kv_transfer.py",
+        "smg_tpu/engine/kv_connector.py",
+        "smg_tpu/parallel/sharding.py",
+    )
     # None = all registered rules
     rules: tuple[str, ...] | None = None
 
@@ -121,6 +133,9 @@ class ModuleContext:
 
     def in_hot_path(self) -> bool:
         return matches_any(self.relpath, self.config.hot_paths)
+
+    def in_shard_path(self) -> bool:
+        return matches_any(self.relpath, self.config.shard_paths)
 
     def line_at(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):

@@ -1,4 +1,4 @@
-"""Rule registry: seven families, each an AST pattern matcher.
+"""Rule registry: ten families, each an AST pattern matcher.
 
 | id         | invariant it guards                                          |
 |------------|--------------------------------------------------------------|
@@ -9,6 +9,9 @@
 | GUARDED    | lock-guarded fields are not accessed lock-free               |
 | FRAMEFOLD  | frame launches account for their sampling-key folds          |
 | LOCKORDER  | nested lock acquisitions keep one global order               |
+| TRACEPURE  | traced bodies stay free of host side effects/tracer escapes  |
+| DONATE     | donated jit buffers are never read after dispatch            |
+| SHARDDISC  | sharded-mode uploads/carries keep the committed sharding     |
 
 ``registered_rules`` returns FRESH instances per call: LOCKORDER is
 run-scoped (it accumulates nested-acquisition pairs across every module in
@@ -22,16 +25,20 @@ from __future__ import annotations
 from typing import Iterable
 
 from smg_tpu.analysis.rules.asyncblock import AsyncBlockRule
+from smg_tpu.analysis.rules.donate import DonateRule
 from smg_tpu.analysis.rules.framefold import FrameFoldRule
 from smg_tpu.analysis.rules.guarded import GuardedRule
 from smg_tpu.analysis.rules.hotsync import HotSyncRule
 from smg_tpu.analysis.rules.lockawait import LockAwaitRule
 from smg_tpu.analysis.rules.lockorder import LockOrderRule
 from smg_tpu.analysis.rules.retrace import RetraceRule
+from smg_tpu.analysis.rules.sharddisc import ShardDiscRule
+from smg_tpu.analysis.rules.tracepure import TracePureRule
 
 _RULE_CLASSES = (
     HotSyncRule, AsyncBlockRule, LockAwaitRule, RetraceRule,
     GuardedRule, FrameFoldRule, LockOrderRule,
+    TracePureRule, DonateRule, ShardDiscRule,
 )
 
 #: id -> class (instantiate per run; see module docstring)

@@ -14,8 +14,9 @@ Checks:
   blocking fetch.  An INTENDED fetch should be ``jax.device_get`` (explicit,
   and what the runtime transfer guard permits); host-only numpy conversions
   should carry a dtype argument or a suppression;
-- ``int()/float()/bool()`` over a subscript — ``int(toks[i])`` materializes
-  one element per call;
+- ``int()/float()/bool()`` over a subscript, a tracked device name, a
+  direct jnp/lax producer call (``float(jnp.sum(x))``) or arithmetic over
+  either (``int(x + 1)``) — each materializes one element per call;
 - device-value truthiness / iteration / print — tracked by a small
   per-function dataflow: names assigned from ``jnp.* / jax.lax.* /
   jax.random.* / jax.nn.*`` calls are device values, and ``if x:``,
@@ -144,9 +145,7 @@ class HotSyncRule:
             return
         if name in _SCALARIZERS and len(call.args) == 1:
             arg = call.args[0]
-            if isinstance(arg, ast.Subscript) or (
-                isinstance(arg, ast.Name) and arg.id in device
-            ):
+            if self._casts_device_value(arg, device):
                 what = ast.unparse(arg) if hasattr(ast, "unparse") else "x"
                 yield ctx.finding(
                     self.id, call,
@@ -154,6 +153,27 @@ class HotSyncRule:
                     "one blocking fetch per element; jax.device_get the "
                     "whole array first",
                 )
+
+    @staticmethod
+    def _casts_device_value(arg: ast.AST, device: set[str]) -> bool:
+        """``float(x)``/``int(x)``/``bool(x)`` is an implicit sync when the
+        argument is a subscript, a tracked device name, a direct jnp/lax
+        producer call (``float(jnp.sum(x))``), or arithmetic over either
+        (``int(x + 1)``) — each calls ``__float__``/``__index__`` on a
+        jax.Array, a blocking device fetch."""
+        if isinstance(arg, ast.Subscript):
+            return True
+        if isinstance(arg, ast.Name):
+            return arg.id in device
+        if isinstance(arg, ast.Call):
+            return _is_device_producer(arg)
+        if isinstance(arg, (ast.BinOp, ast.UnaryOp)):
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Name) and n.id in device:
+                    return True
+                if isinstance(n, ast.Call) and _is_device_producer(n):
+                    return True
+        return False
 
     def _check_truthiness(
         self, ctx: ModuleContext, test: ast.AST, device: set[str]

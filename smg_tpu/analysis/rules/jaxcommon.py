@@ -1,0 +1,263 @@
+"""Shared AST helpers for the JAX-discipline rules (TRACEPURE / DONATE /
+SHARDDISC).
+
+The three rules all need the same two resolutions the generic core doesn't
+provide:
+
+- which call sites hand a callable to a tracer (``jax.jit`` / ``pjit`` /
+  ``lax.while_loop`` / ``lax.scan`` / ``vmap`` — decorator AND call forms),
+  and which positional argument(s) of each wrapper are traced callables;
+- resolving a bare ``Name`` passed as that callable back to its
+  ``FunctionDef`` through the lexical scope chain (the runner's nested
+  ``step`` / ``multi`` / ``cond`` / ``body`` closures, module-level
+  helpers), without following dynamic dispatch.
+
+Everything here is scope-lexical on purpose: a name is resolved to the
+nearest enclosing ``def`` of that name, never across modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import ModuleContext, dotted_name
+
+#: dotted wrapper name -> positional indices holding traced callables
+TRACE_CALLABLE_ARGS: dict[str, tuple[int, ...]] = {
+    "jax.jit": (0,), "jit": (0,),
+    "jax.pjit": (0,), "pjit": (0,),
+    "jax.vmap": (0,), "vmap": (0,),
+    "jax.pmap": (0,), "pmap": (0,),
+    "jax.grad": (0,), "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,), "jax.remat": (0,),
+    "jax.lax.while_loop": (0, 1), "lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,), "lax.scan": (0,),
+    "jax.lax.map": (0,), "lax.map": (0,),
+    "jax.lax.cond": (1, 2), "lax.cond": (1, 2),
+    "jax.lax.fori_loop": (2,), "lax.fori_loop": (2,),
+}
+
+#: wrappers that accept ``donate_argnums`` (the DONATE rule's anchor)
+JIT_WRAPPERS = {"jax.jit", "jit", "jax.pjit", "pjit"}
+
+#: jit-style decorators marking a def as traced
+_JIT_DECORATORS = {"jax.jit", "jit", "jax.pjit", "pjit", "jax.vmap", "vmap",
+                   "jax.pmap", "pmap"}
+
+
+def is_traced_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` / ``@partial(jax.jit, ...)`` / ``@jax.jit(...)``."""
+    name = dotted_name(dec)
+    if name in _JIT_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_DECORATORS:
+            return True
+        if fname in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_DECORATORS
+    return False
+
+
+def _scope_functions(scope: ast.AST) -> dict[str, ast.FunctionDef]:
+    """Function defs that are DIRECT statements of ``scope`` (recursing
+    through if/try/with blocks but not into nested function bodies)."""
+    out: dict[str, ast.FunctionDef] = {}
+    body = getattr(scope, "body", [])
+    stack = list(body) + list(getattr(scope, "orelse", []))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.FunctionDef):
+            out.setdefault(n.name, n)
+            continue  # do not descend into its body
+        if isinstance(n, (ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(n):
+            stack.append(child)
+    return out
+
+
+def resolve_callable(
+    ctx: ModuleContext, at: ast.AST, expr: ast.AST
+) -> ast.FunctionDef | ast.Lambda | None:
+    """Resolve a callable expression at a trace site to its definition:
+    inline lambdas directly, bare names through the lexical scope chain
+    (enclosing defs outward, then module top level)."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if not isinstance(expr, ast.Name):
+        return None
+    scopes: list[ast.AST] = []
+    fn = ctx.enclosing_function(at)
+    while fn is not None:
+        scopes.append(fn)
+        fn = ctx.enclosing_function(fn)
+    scopes.append(ctx.tree)
+    for scope in scopes:
+        hit = _scope_functions(scope).get(expr.id)
+        if hit is not None:
+            return hit
+    return None
+
+
+def static_param_names(
+    wrapper: ast.AST, body: ast.FunctionDef | ast.Lambda
+) -> set[str]:
+    """Parameter names pinned host-static by ``static_argnames`` /
+    ``static_argnums`` on a jit wrapper call/decorator — their values
+    concretize at trace time, so branching on them is legal."""
+    out: set[str] = set()
+    if not isinstance(wrapper, ast.Call):
+        return out
+    a = body.args
+    pos_params = [p.arg for p in a.posonlyargs + a.args]
+    for kw in wrapper.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            nums = literal_int_set(kw.value)
+            for i in nums or ():
+                if 0 <= i < len(pos_params):
+                    out.add(pos_params[i])
+    return out
+
+
+def iter_traced_bodies(
+    ctx: ModuleContext,
+) -> Iterator[tuple[ast.FunctionDef | ast.Lambda, ast.AST, str, set[str]]]:
+    """Every (body, site, wrapper-name, static-params) handed to a tracer in
+    the module: decorator forms and call-site closure forms, deduplicated
+    per body."""
+    seen: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if is_traced_decorator(dec) and id(node) not in seen:
+                    seen.add(id(node))
+                    # @partial(jax.jit, static_argnames=...) carries the
+                    # keywords on the partial call itself
+                    yield (node, node, dotted_name(dec) or "jax.jit",
+                           static_param_names(dec, node))
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            positions = TRACE_CALLABLE_ARGS.get(name)
+            if not positions:
+                continue
+            for pos in positions:
+                if pos >= len(node.args):
+                    continue
+                body = resolve_callable(ctx, node, node.args[pos])
+                if body is not None and id(body) not in seen:
+                    seen.add(id(body))
+                    yield body, node, name, static_param_names(node, body)
+
+
+def param_names(fn: ast.FunctionDef | ast.Lambda) -> set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def positional_arity(fn: ast.FunctionDef | ast.Lambda) -> int | None:
+    """Number of positional parameters, or None when ``*args`` makes the
+    arity unbounded."""
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+def walk_body(fn: ast.FunctionDef | ast.Lambda) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs/lambdas
+    (those are traced and analyzed separately when referenced)."""
+    stack: list[ast.AST] = (
+        [fn.body] if isinstance(fn, ast.Lambda) else list(fn.body)
+    )
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def local_bindings(fn: ast.FunctionDef | ast.Lambda) -> set[str]:
+    """Names bound inside the body (params, assignment targets, loop vars,
+    with-as, comprehension vars, nested defs) — everything that is NOT a
+    closure capture."""
+    names = param_names(fn)
+    for n in walk_body(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def literal_int_set(node: ast.AST) -> set[int] | None:
+    """Integers in a literal ``donate_argnums`` value: an int constant, a
+    tuple/list of them, or concatenations thereof.  None = not static."""
+    if isinstance(node, ast.Constant):
+        return {node.value} if isinstance(node.value, int) else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[int] = set()
+        for e in node.elts:
+            sub = literal_int_set(e)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = literal_int_set(node.left)
+        right = literal_int_set(node.right)
+        if left is None or right is None:
+            return None
+        return left | right
+    if isinstance(node, ast.IfExp):
+        # `(5, 6) if cond else ()` — union both arms (a read that is unsafe
+        # when donation is on is a bug regardless of the runtime policy)
+        left = literal_int_set(node.body)
+        right = literal_int_set(node.orelse)
+        if left is None or right is None:
+            return None
+        return left | right
+    return None
+
+
+def resolve_argnums(
+    ctx: ModuleContext, site: ast.Call, value: ast.AST
+) -> set[int] | None:
+    """Static positions from a ``donate_argnums=`` value: literals directly,
+    a Name through every literal assignment to it in the enclosing function
+    (union — conditional re-binds like ``donate = ()`` narrow the policy at
+    runtime, not the static contract)."""
+    lit = literal_int_set(value)
+    if lit is not None:
+        return lit
+    if not isinstance(value, ast.Name):
+        return None
+    fn = ctx.enclosing_function(site)
+    scope = fn if fn is not None else ctx.tree
+    out: set[int] = set()
+    found = False
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == value.id for t in n.targets
+        ):
+            sub = literal_int_set(n.value)
+            if sub is None:
+                return None
+            out |= sub
+            found = True
+    return out if found else None

@@ -1,0 +1,137 @@
+"""SHARDDISC: committed-sharding discipline in sharded-mode hot modules.
+
+PR 15's tensor-parallel mode works because every steady-state input is
+COMMITTED to the mesh's sharding before it reaches a pjit boundary (the
+runner's ``_dev(sharding)`` / ``upload`` / ``_scalar_up`` helpers): an
+uncommitted array silently pays an implicit device-to-device reshard on
+every launch — ~10 per step before PR 15 eliminated them — and is the
+first thing the tp8 steady-state transfer guard trips on.  This rule keeps
+that discipline true as PD-disaggregation / KV-migration code lands on
+the same modules (``LintConfig.shard_paths``).
+
+Checks:
+
+- bare ``jax.device_put(x)`` with neither a device nor a sharding: the
+  array lands uncommitted on the default device — route through the
+  committed-sharding helpers or pass the target sharding explicitly;
+- a KV-sized carry (``jnp.zeros``-like, rank >= 3) entering a
+  ``lax.while_loop`` / ``lax.scan`` / ``lax.fori_loop`` without a
+  ``shard_hint`` / ``with_sharding_constraint`` rewrap: the SPMD
+  partitioner is free to replicate the carry and all-gather at the final
+  scatter (the megastep's ``hk0 = shard_hint(jnp.zeros(...), ...)``
+  pattern is the sanctioned form — a no-op when the mesh is None, so
+  single-device modules lose nothing by complying).
+
+Deliberately NOT in scope: ``shard_map``-style modules (ring attention,
+pipeline parallel) where the per-device view is manual and a sharding
+constraint is wrong by construction — ``shard_paths`` excludes them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext, dotted_name
+
+_DEVICE_PUT = {"jax.device_put"}
+_HINT_NAMES = {"shard_hint", "with_sharding_constraint",
+               "jax.lax.with_sharding_constraint",
+               "lax.with_sharding_constraint"}
+_ZEROS_LIKE = {"jnp.zeros", "jnp.ones", "jnp.empty", "jnp.full",
+               "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty",
+               "jax.numpy.full"}
+#: dotted loop name -> positional index of the carry/init operand
+_LOOP_INITS = {
+    "jax.lax.while_loop": 2, "lax.while_loop": 2,
+    "jax.lax.scan": 1, "lax.scan": 1,
+    "jax.lax.fori_loop": 3, "lax.fori_loop": 3,
+}
+
+
+def _is_big_zeros(call: ast.AST) -> bool:
+    """A ``jnp.zeros((L, B, N, KD), ...)``-style producer whose literal
+    shape has rank >= 3 — the KV-sized carries worth a lane hint (small
+    [B]/[B, N] bookkeeping carries are cheap to replicate and stay
+    exempt)."""
+    if not (isinstance(call, ast.Call)
+            and dotted_name(call.func) in _ZEROS_LIKE and call.args):
+        return False
+    shape = call.args[0]
+    return isinstance(shape, (ast.Tuple, ast.List)) and len(shape.elts) >= 3
+
+
+def _is_hint_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in _HINT_NAMES)
+
+
+class ShardDiscRule:
+    id = "SHARDDISC"
+    description = "device upload or loop carry bypasses committed sharding"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.in_shard_path():
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _DEVICE_PUT:
+                yield from self._check_device_put(ctx, node)
+            elif name in _LOOP_INITS:
+                yield from self._check_loop_carry(ctx, node,
+                                                  _LOOP_INITS[name])
+
+    def _check_device_put(
+        self, ctx: ModuleContext, call: ast.Call
+    ) -> Iterator[Finding]:
+        has_placement = len(call.args) >= 2 or any(
+            k.arg in ("device", "sharding", "dst") or k.arg is None
+            for k in call.keywords
+        )
+        if not has_placement:
+            yield ctx.finding(
+                self.id, call,
+                "bare jax.device_put(x) lands UNCOMMITTED on the default "
+                "device — under a mesh every sharded launch then pays an "
+                "implicit reshard; pass the committed sharding (or go "
+                "through _dev/upload/_scalar_up)",
+            )
+
+    def _check_loop_carry(
+        self, ctx: ModuleContext, call: ast.Call, init_pos: int
+    ) -> Iterator[Finding]:
+        if init_pos >= len(call.args):
+            return
+        init = call.args[init_pos]
+        components = list(init.elts) if isinstance(init, ast.Tuple) else [init]
+        fn = ctx.enclosing_function(call)
+        for comp in components:
+            if _is_big_zeros(comp):
+                yield ctx.finding(
+                    self.id, comp,
+                    "fresh KV-sized carry enters the loop without a "
+                    "shard_hint/with_sharding_constraint — the partitioner "
+                    "may replicate it and all-gather at the scatter; wrap "
+                    "it (no-op when mesh is None)",
+                )
+                continue
+            if not isinstance(comp, ast.Name) or fn is None:
+                continue
+            last = None
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Assign) and n.lineno < call.lineno
+                        and (last is None or n.lineno > last.lineno)
+                        and any(isinstance(t, ast.Name) and t.id == comp.id
+                                for t in n.targets)):
+                    last = n
+            if last is not None and _is_big_zeros(last.value) \
+                    and not _is_hint_call(last.value):
+                yield ctx.finding(
+                    self.id, last,
+                    f"loop carry '{comp.id}' is a fresh KV-sized buffer with "
+                    "no shard_hint/with_sharding_constraint before the loop "
+                    "— rewrap it so the final scatter stays shard-local "
+                    "(no-op when mesh is None)",
+                )

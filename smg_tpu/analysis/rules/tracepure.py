@@ -1,0 +1,259 @@
+"""TRACEPURE: traced function bodies stay free of host side effects.
+
+A body handed to ``jax.jit`` / ``pjit`` / ``lax.while_loop`` / ``lax.scan``
+/ ``vmap`` runs ONCE, at trace time, with abstract tracers for arguments.
+Host-side work inside it is therefore either a silent constant-bake (the
+``time.time()`` / ``random.random()`` / ``np.random`` class: one value
+frozen into the program forever), a leaked tracer (storing ``self.X = h``
+or appending to an outer-scope list persists a tracer object past the
+trace — ``UnexpectedTracerError`` at best, a retained sub-graph at worst),
+or trace-time-only control flow (``if``/``while`` on a traced value raises
+``TracerBoolConversionError``; on a closure device value it silently
+specializes the program to one branch).
+
+Per traced body the rule runs a small forward taint pass (parameters and
+anything computed from them or from ``jnp.* / lax.* / jax.*`` producers
+are traced) and flags:
+
+- attribute stores whose root object is not local to the body
+  (``self.X = ...`` — the classic tracer escape);
+- mutation calls (``append`` / ``extend`` / ``add`` / ``update`` ...) on
+  outer-scope containers;
+- ``time.*`` / stdlib ``random.*`` / ``np.random.*`` / ``logging`` /
+  logger / ``print`` calls (imports are resolved, so ``jax.random`` never
+  matches);
+- Python ``if`` / ``while`` branching on a traced value (``is None``
+  checks on closure sentinels stay allowed — the runner's
+  ``if use_pen:`` feature staging is host-static and untainted).
+
+Bodies are found in both decorator form and call-site closure form, with
+bare names resolved through the lexical scope chain — the runner's nested
+``step`` / ``multi`` / ``cond`` / ``body`` closures and module-level
+helpers all resolve.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from smg_tpu.analysis.core import Finding, ModuleContext, dotted_name
+from smg_tpu.analysis.rules.jaxcommon import (
+    iter_traced_bodies,
+    local_bindings,
+    param_names,
+    walk_body,
+)
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "remove", "discard", "appendleft", "popleft", "write"}
+_TRACED_PRODUCER_PREFIXES = ("jnp.", "jax.", "lax.")
+_HOST_EFFECT_PREFIXES = ("time.", "random.", "logging.", "np.random.",
+                         "numpy.random.", "os.", "sys.", "threading.")
+_LOGGER_ROOTS = {"logger", "log", "LOG", "LOGGER", "_logger"}
+
+
+def _import_map(tree: ast.Module) -> dict[str, str]:
+    """Bound name -> canonical dotted module path, so ``from jax import
+    random`` is distinguishable from stdlib ``import random``."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _resolve_dotted(name: str, imports: dict[str, str]) -> str:
+    root, _, rest = name.partition(".")
+    base = imports.get(root)
+    if base is None:
+        return name
+    return f"{base}.{rest}" if rest else base
+
+
+#: attribute/metadata accesses that are HOST-STATIC even on a tracer —
+#: ``x.shape``/``x.dtype`` unpacks drive shape math, not device values
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _names_in(expr: ast.AST) -> set[str]:
+    """Name loads in ``expr`` that carry DYNAMIC (traced) values: names only
+    reached through ``.shape``/``.dtype``/``len()`` are static metadata and
+    excluded — ``L, P, ps, KD = k_cache.shape`` taints nothing."""
+    out: set[str] = set()
+
+    def rec(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call) and dotted_name(n.func) == "len":
+            return
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+            return
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    rec(expr)
+    return out
+
+
+def _is_producer_call(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and dotted_name(expr.func).startswith(
+        _TRACED_PRODUCER_PREFIXES
+    )
+
+
+def _tainted_names(
+    fn: ast.FunctionDef | ast.Lambda, statics: set[str] = frozenset()
+) -> set[str]:
+    """Forward taint: params (minus ``static_argnames`` params — those
+    concretize at trace time), then fixpoint over assignments whose value
+    references a tainted name or a jnp/lax producer call."""
+    tainted = set(param_names(fn)) - statics
+    for _ in range(10):
+        changed = False
+        for node in walk_body(fn):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            hot = bool(_names_in(value) & tainted) or any(
+                _is_producer_call(c) for c in ast.walk(value)
+                if isinstance(c, ast.Call)
+            )
+            if not hot:
+                continue
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name) and leaf.id not in tainted:
+                        tainted.add(leaf.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _test_is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` (possibly and-ed): host-static
+    sentinel staging, not a tracer branch."""
+    if isinstance(test, ast.BoolOp):
+        return all(_test_is_none_check(v) for v in test.values)
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+class TracePureRule:
+    id = "TRACEPURE"
+    description = "host side effect or tracer escape inside a traced function"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        imports = _import_map(ctx.tree)
+        for body, _site, wrapper, statics in iter_traced_bodies(ctx):
+            yield from self._check_body(ctx, body, wrapper, imports, statics)
+
+    def _check_body(
+        self, ctx: ModuleContext, fn: ast.FunctionDef | ast.Lambda,
+        wrapper: str, imports: dict[str, str], statics: set[str],
+    ) -> Iterator[Finding]:
+        locals_ = local_bindings(fn)
+        tainted = _tainted_names(fn, statics)
+        label = getattr(fn, "name", "<lambda>")
+        for node in walk_body(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if not isinstance(leaf, ast.Attribute):
+                            continue
+                        root = leaf
+                        while isinstance(root, ast.Attribute):
+                            root = root.value
+                        if isinstance(root, ast.Name) and root.id not in locals_:
+                            yield ctx.finding(
+                                self.id, node,
+                                f"attribute store '{dotted_name(leaf)}' inside "
+                                f"traced '{label}' ({wrapper}) runs once at "
+                                "trace time and escapes a tracer — return the "
+                                "value through the program outputs instead",
+                            )
+                            break
+            elif isinstance(node, ast.Call):
+                yield from self._check_call(
+                    ctx, node, label, wrapper, locals_, imports
+                )
+            elif isinstance(node, (ast.If, ast.While)):
+                if _test_is_none_check(node.test):
+                    continue
+                hot = sorted(_names_in(node.test) & tainted)
+                produced = any(
+                    _is_producer_call(c) for c in ast.walk(node.test)
+                    if isinstance(c, ast.Call)
+                )
+                if hot or produced:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    what = f"'{hot[0]}'" if hot else "a device expression"
+                    yield ctx.finding(
+                        self.id, node,
+                        f"Python {kind} on traced value {what} inside "
+                        f"'{label}' ({wrapper}) — concretizes at trace time; "
+                        "use lax.cond/lax.select (closure booleans staging "
+                        "features are fine, traced operands are not)",
+                    )
+
+    def _check_call(
+        self, ctx: ModuleContext, call: ast.Call, label: str, wrapper: str,
+        locals_: set[str], imports: dict[str, str],
+    ) -> Iterator[Finding]:
+        name = dotted_name(call.func)
+        if name == "print":
+            yield ctx.finding(
+                self.id, call,
+                f"print() inside traced '{label}' ({wrapper}) fires once at "
+                "trace time (and formats tracers) — use jax.debug.print for "
+                "runtime values",
+            )
+            return
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _MUTATORS
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id not in locals_
+                # functional-style calls whose RESULT is consumed
+                # (optax's `updates, s = tx.update(...)`) are pure —
+                # container mutation is a bare expression statement
+                and isinstance(ctx.parent(call), ast.Expr)):
+            yield ctx.finding(
+                self.id, call,
+                f"'{call.func.value.id}.{call.func.attr}(...)' mutates an "
+                f"outer-scope container inside traced '{label}' ({wrapper}) "
+                "— runs once at trace time and leaks tracers into host state",
+            )
+            return
+        if not name or "." not in name:
+            return
+        resolved = _resolve_dotted(name, imports)
+        root = name.split(".", 1)[0]
+        if resolved.startswith(_HOST_EFFECT_PREFIXES) or (
+            root in _LOGGER_ROOTS
+        ):
+            # jax.random / jnp resolve to jax.* and never reach here
+            if resolved.startswith(("jax.", "jnp.")):
+                return
+            yield ctx.finding(
+                self.id, call,
+                f"host call '{name}()' inside traced '{label}' ({wrapper}) "
+                "executes once at trace time — its value is baked into the "
+                "compiled program (move it outside the traced body)",
+            )

@@ -17,6 +17,21 @@ the real engine, wired into ``tests/test_analysis.py`` and the
   ``jax.monitoring``.  After warmup, steady-state decode must compile
   nothing: a nonzero count is a retrace regression even when throughput
   noise hides the stall;
+- :class:`ProgramAuditor` / :func:`program_audit` — the compiled-program
+  auditor behind the TRACEPURE/DONATE/SHARDDISC static rules.  The runner
+  registers every cached jit family (``runner._compiled``) through
+  :meth:`ProgramAuditor.wrap` together with its committed ``in_shardings``
+  and intended ``donate_argnums``; once ARMED (post-warmup), each launch
+  captures per-argument specs (shape/dtype/sharding/committed flag) at
+  negligible overhead, and :func:`program_audit` then asserts from the
+  lowered/compiled representation that (1) every steady-state input's
+  sharding matches the mesh commitment — no implicit per-launch reshard,
+  (2) every intended donation actually aliased an output
+  (``input_output_alias`` in the compiled HLO — donation silently no-ops
+  on mismatch), and (3) any recompile carries PROVENANCE: which argument's
+  shape/dtype/sharding changed between the two launches (the compile
+  counter says "a recompile happened"; this says why).  Surfaced via
+  ``Engine.loads()["programs"]`` and the ``program_audit`` CI section;
 - :func:`lock_order_sentinel` — lockdep-style dynamic lock-order tracking,
   the runtime twin of the LOCKORDER static rule.  The static rule sees only
   lexical nesting; the sentinel sees the real graph (an engine-lock holder
@@ -131,6 +146,350 @@ def steady_state_guard(max_compiles: int = 0):
             f"(budget {max_compiles}): a jit signature changed per step — "
             "see the RETRACE rule docs in smg_tpu/analysis/rules/retrace.py"
         )
+
+
+# ---- compiled-program auditor (program_audit) ----
+
+
+def _describe_args(args):
+    """Flatten a launch's argument tree into (signature, leaf-entries,
+    spec-tree).  Each array leaf entry records path / shape / dtype /
+    sharding (object + repr) / committed flag / device ids; non-array
+    leaves are recorded as host-static.  The spec tree mirrors ``args``
+    with ``ShapeDtypeStruct`` (sharding attached) in place of arrays, so
+    the auditor can re-lower the program later without holding buffers."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(args)
+    entries = []
+    spec_leaves = []
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path) or "<root>"
+        if isinstance(leaf, jax.Array):
+            sh = leaf.sharding
+            entries.append({
+                "path": pstr,
+                "shape": tuple(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "sharding": repr(sh),
+                "committed": bool(getattr(leaf, "committed", True)),
+                "devices": tuple(sorted(d.id for d in sh.device_set)),
+                "_sharding": sh,
+            })
+            spec_leaves.append(
+                jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+            )
+        else:
+            entries.append({
+                "path": pstr, "shape": None, "dtype": type(leaf).__name__,
+                "sharding": None, "committed": True, "devices": (),
+                "_sharding": None,
+            })
+            spec_leaves.append(leaf)
+    sig = tuple(
+        (e["path"], e["shape"], e["dtype"], e["sharding"]) for e in entries
+    )
+    return sig, entries, jax.tree_util.tree_unflatten(treedef, spec_leaves)
+
+
+def _sig_diff(old: list[dict], new: list[dict]) -> list[dict]:
+    """Which argument changed between two launch signatures — the
+    recompile's PROVENANCE.  Compares leaf-wise; a structural change
+    (different leaf count) is reported as such."""
+    if len(old) != len(new):
+        return [{"arg": "<tree>", "field": "structure",
+                 "before": len(old), "after": len(new)}]
+    out = []
+    for o, n in zip(old, new):
+        for field in ("shape", "dtype", "sharding"):
+            if o[field] != n[field]:
+                out.append({
+                    "arg": n["path"], "field": field,
+                    "before": o[field], "after": n[field],
+                })
+    return out
+
+
+def _count_output_aliases(hlo_text: str) -> int:
+    """Number of aliased entries in the compiled module's
+    ``input_output_alias={...}`` attribute (brace-matched — the entries
+    themselves contain nested ``{}``)."""
+    import re
+
+    marker = "input_output_alias={"
+    start = hlo_text.find(marker)
+    if start < 0:
+        return 0
+    i = start + len(marker)
+    depth = 1
+    buf = []
+    while i < len(hlo_text) and depth:
+        c = hlo_text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+        if depth:
+            buf.append(c)
+        i += 1
+    # entries look like `{0}: (0, {}, may-alias)` / `{1}: (4, {}, ...)`
+    return len(re.findall(r"\{[0-9, ]*\}\s*:", "".join(buf)))
+
+
+class _ProgramRecord:
+    __slots__ = ("key", "fn", "donate", "in_shardings", "launches",
+                 "recompiles", "last_sig", "last_entries", "last_specs",
+                 "provenance")
+
+    def __init__(self, key, fn, donate, in_shardings):
+        self.key = key
+        self.fn = fn
+        self.donate = tuple(donate or ())
+        self.in_shardings = in_shardings
+        self.launches = 0
+        self.recompiles = 0
+        self.last_sig = None
+        self.last_entries = None
+        self.last_specs = None
+        self.provenance: list[dict] = []
+
+
+class ProgramAuditor:
+    """Registry + launch interceptor for every cached compiled program.
+
+    The runner routes each jit family through :meth:`wrap` at cache-fill
+    time, declaring the family's intended donation positions and (in mesh
+    mode) the committed input shardings.  Unarmed, the wrapper is a single
+    attribute check per launch.  Armed (:meth:`arm`, post-warmup), each
+    launch snapshots the argument tree's shapes/dtypes/shardings BEFORE
+    dispatch (donation invalidates input buffers afterwards) and brackets
+    the call with the process compile counter — so a steady-state launch
+    that compiles gets a provenance entry naming exactly which argument's
+    shape/dtype/sharding differed from the previous launch.
+
+    :meth:`audit` then re-lowers each captured program from its specs and
+    checks the compiled representation itself: committed-sharding
+    conformance for every input, and ``input_output_alias`` coverage for
+    every intended donation.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._records: dict = {}
+        self.armed = False
+
+    # ---- registration / launch path ----
+
+    def wrap(self, key, fn, *, donate=(), in_shardings=None):
+        """Register compiled-program ``fn`` under ``key`` and return the
+        launch wrapper the runner caches in its place."""
+        rec = _ProgramRecord(key, fn, donate, in_shardings)
+        with self._mu:
+            self._records[key] = rec
+
+        def launch(*args):
+            if not self.armed:
+                return fn(*args)
+            _ensure_listener()
+            sig, entries, specs = _describe_args(args)
+            pre = _compile_count
+            out = fn(*args)
+            compiled = _compile_count - pre
+            with self._mu:
+                rec.launches += 1
+                if compiled and rec.last_sig is not None:
+                    rec.recompiles += compiled
+                    changed = _sig_diff(rec.last_entries, entries)
+                    rec.provenance.append({
+                        "key": repr(key),
+                        "compiles": compiled,
+                        "changed": changed or
+                        [{"arg": "<none>", "field": "unknown",
+                          "before": None, "after": None}],
+                    })
+                rec.last_sig = sig
+                rec.last_entries = entries
+                rec.last_specs = specs
+            return out
+
+        launch.__wrapped__ = fn
+        return launch
+
+    def arm(self) -> None:
+        """Start capturing launch signatures (call after warmup)."""
+        _ensure_listener()
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def forget(self, keys) -> None:
+        """Drop records for invalidated programs (runner cache eviction)."""
+        with self._mu:
+            for k in list(keys):
+                self._records.pop(k, None)
+
+    # ---- reporting ----
+
+    def snapshot(self) -> dict:
+        """Cheap JSON-safe summary for ``Engine.loads()["programs"]`` —
+        no lowering, no compilation."""
+        with self._mu:
+            programs = [
+                {
+                    "key": repr(rec.key),
+                    "launches": rec.launches,
+                    "recompiles": rec.recompiles,
+                    "donate": list(rec.donate),
+                    "audited": rec.last_sig is not None,
+                }
+                for rec in self._records.values()
+            ]
+        return {
+            "armed": self.armed,
+            "recompiles": sum(p["recompiles"] for p in programs),
+            "programs": programs,
+        }
+
+    def audit(self, *, check_donation: bool = True) -> dict:
+        """Walk every captured program and verify it from the compiled
+        representation.  Returns a report dict; ``report["clean"]`` is the
+        single go/no-go bit (0 uncommitted/mismatched inputs, every
+        intended donation verified-aliased)."""
+        import jax
+
+        with self._mu:
+            records = list(self._records.values())
+        programs = []
+        uncommitted = mismatched = unverified = recompiles = 0
+        for rec in records:
+            entry: dict = {
+                "key": repr(rec.key),
+                "launches": rec.launches,
+                "recompiles": rec.recompiles,
+                "provenance": list(rec.provenance),
+                "audited": rec.last_sig is not None,
+            }
+            recompiles += rec.recompiles
+            if rec.last_sig is None:
+                programs.append(entry)
+                continue
+            array_entries = [e for e in rec.last_entries
+                             if e["_sharding"] is not None]
+            bad_inputs = []
+            if rec.in_shardings is not None:
+                committed = [
+                    s for s in jax.tree_util.tree_leaves(rec.in_shardings)
+                    if isinstance(s, jax.sharding.Sharding)
+                ]
+                if len(committed) != len(array_entries):
+                    entry["sharding_check"] = (
+                        f"structure mismatch: {len(committed)} committed "
+                        f"shardings vs {len(array_entries)} array inputs"
+                    )
+                    mismatched += 1
+                else:
+                    for e, want in zip(array_entries, committed):
+                        if not e["committed"]:
+                            bad_inputs.append({
+                                "arg": e["path"], "why": "uncommitted",
+                                "sharding": e["sharding"],
+                            })
+                            uncommitted += 1
+                        elif not e["_sharding"].is_equivalent_to(
+                            want, len(e["shape"])
+                        ):
+                            bad_inputs.append({
+                                "arg": e["path"],
+                                "why": "sharding mismatch (implicit reshard "
+                                       "at every launch)",
+                                "sharding": e["sharding"],
+                                "committed": repr(want),
+                            })
+                            mismatched += 1
+            else:
+                # single-device mode: every input must sit on ONE device
+                # and all inputs on the SAME one — anything else is a
+                # cross-device transfer per launch
+                placements = {e["devices"] for e in array_entries
+                              if e["devices"]}
+                if len(placements) > 1 or any(
+                    len(d) > 1 for d in placements
+                ):
+                    for e in array_entries:
+                        if len(e["devices"]) != 1:
+                            bad_inputs.append({
+                                "arg": e["path"],
+                                "why": "spans multiple devices in "
+                                       "single-device mode",
+                                "sharding": e["sharding"],
+                            })
+                            mismatched += 1
+            if bad_inputs:
+                entry["bad_inputs"] = bad_inputs
+            if check_donation and rec.donate:
+                try:
+                    lowered = rec.fn.lower(*rec.last_specs)
+                    intended = sum(
+                        1 for ai in jax.tree_util.tree_leaves(
+                            lowered.args_info)
+                        if getattr(ai, "donated", False)
+                    )
+                    aliased = _count_output_aliases(
+                        lowered.compile().as_text()
+                    )
+                    verified = aliased >= intended
+                    entry["donation"] = {
+                        "declared": list(rec.donate),
+                        "intended": intended,
+                        "aliased": aliased,
+                        "verified": verified,
+                    }
+                    if not verified:
+                        unverified += 1
+                except Exception as exc:  # pragma: no cover - defensive
+                    entry["donation"] = {
+                        "declared": list(rec.donate),
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "verified": False,
+                    }
+                    unverified += 1
+            programs.append(entry)
+        return {
+            "armed": self.armed,
+            "programs": programs,
+            "uncommitted_inputs": uncommitted,
+            "sharding_mismatches": mismatched,
+            "donation_unverified": unverified,
+            "recompiles": recompiles,
+            "clean": not (uncommitted or mismatched or unverified),
+        }
+
+
+def program_audit(target, *, check_donation: bool = True) -> dict:
+    """Audit every cached compiled program of ``target`` — a
+    :class:`ProgramAuditor`, or anything exposing one as ``_programs``
+    (the runner) or ``runner._programs`` (the engine)::
+
+        eng.warmup(); eng.runner._programs.arm()
+        ...steady-state traffic...
+        report = program_audit(eng)
+        assert report["clean"], report
+
+    Asserts from the lowered/compiled representation: committed-sharding
+    conformance for every captured input, ``input_output_alias`` coverage
+    for every intended donation, and recompile provenance for any
+    signature change observed while armed."""
+    auditor = target
+    for attr in ("runner", "_programs"):
+        nxt = getattr(auditor, attr, None)
+        if nxt is not None and not isinstance(auditor, ProgramAuditor):
+            auditor = nxt
+    if not isinstance(auditor, ProgramAuditor):
+        raise TypeError(
+            f"program_audit: no ProgramAuditor reachable from {target!r}"
+        )
+    return auditor.audit(check_donation=check_donation)
 
 
 # ---- lock-order sentinel (the LOCKORDER rule's runtime twin) ----

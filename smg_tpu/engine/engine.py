@@ -296,9 +296,22 @@ class Engine:
                 # harness) assert steady-state cleanliness from loads() /
                 # /scheduler without reaching into scheduler internals
                 out["audit"] = self._audit_locked()
+                # compiled-program inventory (launch/recompile counters per
+                # cached jit family) — cheap snapshot, no lowering; the full
+                # verification pass is program_audit()
+                out["programs"] = self.runner._programs.snapshot()
         out["healthy"] = self.healthy
         out["watchdog_stalls"] = self.num_watchdog_stalls
         return out
+
+    def program_audit(self, *, check_donation: bool = True) -> dict:
+        """Compiled-program audit (analysis/runtime_guards.ProgramAuditor):
+        arm ``self.runner._programs`` after warmup, run steady-state
+        traffic, then call this.  Verifies from the lowered/compiled
+        representation that every captured input matched its mesh
+        commitment, every intended donation actually aliased an output, and
+        reports provenance for any recompile observed while armed."""
+        return self.runner.program_audit(check_donation=check_donation)
 
     def _audit_locked(self) -> dict:
         """``Scheduler.audit`` + the one leak class only the engine sees

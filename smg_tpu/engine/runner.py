@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from smg_tpu.analysis.runtime_guards import ProgramAuditor
 from smg_tpu.engine.config import EngineConfig
 from smg_tpu.engine.donation import kv_donation_policy
 from smg_tpu.engine.kv_cache import KvCacheSpec, create_kv_buffers, plan_cache
@@ -59,6 +60,7 @@ def _dev(x, dtype, sharding=None) -> jax.Array:
         return x if x.dtype == dtype else jnp.asarray(x, dtype)
     if sharding is not None:
         return jax.device_put(np.asarray(x, dtype), sharding)
+    # smglint: disable-next=SHARDDISC single-device path: mesh is None, there is no commitment target
     return jax.device_put(np.asarray(x, dtype))
 
 
@@ -277,6 +279,11 @@ class ModelRunner:
         self._fold_in = None  # jitted fold_in, built on first key (see _next_key)
         self._step = 0
         self._compiled: dict = {}
+        # compiled-program auditor: every jit family below registers through
+        # wrap() with its intended donation positions and (mesh mode) the
+        # committed in_shardings, so program_audit() can verify commitment /
+        # donation-aliasing / recompile provenance from captured launches
+        self._programs = ProgramAuditor()
         # Penalty state lives on-device so the decode horizon can update it
         # inside the scan (output counts feed back without host round trips).
         # Lazy: most workloads never set a penalty, and the buffers are
@@ -321,10 +328,21 @@ class ModelRunner:
         deliberately NOT part of the cache key (normal operation never flips
         it for a live shape — only benchmarks do)."""
         if kind is None:
+            dropped = list(self._compiled)
             self._compiled.clear()
         else:
-            for k in [k for k in self._compiled if k[0] == kind]:
+            dropped = [k for k in self._compiled if k[0] == kind]
+            for k in dropped:
                 del self._compiled[k]
+        self._programs.forget(dropped)
+
+    def program_audit(self, *, check_donation: bool = True) -> dict:
+        """Audit every cached compiled program from its compiled
+        representation (see analysis/runtime_guards.ProgramAuditor): arm
+        ``self._programs`` after warmup, run steady-state traffic, then call
+        this — ``report["clean"]`` asserts zero uncommitted/mismatched
+        inputs and every intended donation verified-aliased."""
+        return self._programs.audit(check_donation=check_donation)
 
     def _attn_impl_for(self, B: int, mp: int) -> str:
         """Per-shape kernel choice.  Short contexts: XLA's fused
@@ -539,6 +557,7 @@ class ModelRunner:
         jit boundary — the transfer the steady-state guard forbids)."""
         if self._replicated is not None:
             return jax.device_put(x, self._replicated)
+        # smglint: disable-next=SHARDDISC single-device path: mesh is None, there is no commitment target
         return jax.device_put(x)
 
     def upload(self, x, dtype=None) -> jax.Array:
@@ -640,7 +659,9 @@ class ModelRunner:
                 donate_argnums=(5, 6),
             )
         else:
+            in_sh = None
             fn = jax.jit(step, donate_argnums=(5, 6))
+        fn = self._programs.wrap(k, fn, donate=(5, 6), in_shardings=in_sh)
         self._compiled[k] = fn
         return fn
 
@@ -709,7 +730,9 @@ class ModelRunner:
                 donate_argnums=donate,
             )
         else:
+            in_sh = None
             fn = jax.jit(step, donate_argnums=donate)
+        fn = self._programs.wrap(k, fn, donate=donate, in_shardings=in_sh)
         self._compiled[k] = fn
         return fn
 
@@ -773,7 +796,9 @@ class ModelRunner:
                 donate_argnums=(5, 6),
             )
         else:
+            in_sh = None
             fn = jax.jit(step, donate_argnums=(5, 6))
+        fn = self._programs.wrap(k, fn, donate=(5, 6), in_shardings=in_sh)
         self._compiled[k] = fn
         return fn
 
@@ -1072,7 +1097,9 @@ class ModelRunner:
             fn = jax.jit(multi, in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=donate)
         else:
+            in_sh = None
             fn = jax.jit(multi, donate_argnums=donate)
+        fn = self._programs.wrap(k, fn, donate=donate, in_shardings=in_sh)
         self._compiled[k] = fn
         return fn
 
@@ -1244,15 +1271,18 @@ class ModelRunner:
 
         if self.mesh is not None:
             r = self._replicated
+            in_sh = (self.param_shardings, r, r, r,
+                     self.kv_sharding, self.kv_sharding, r, r, r, r, r, r)
             fn = jax.jit(
                 step,
-                in_shardings=(self.param_shardings, r, r, r,
-                              self.kv_sharding, self.kv_sharding, r, r, r, r, r, r),
+                in_shardings=in_sh,
                 out_shardings=(r, r, self.kv_sharding, self.kv_sharding),
                 donate_argnums=(4, 5),
             )
         else:
+            in_sh = None
             fn = jax.jit(step, donate_argnums=(4, 5))
+        fn = self._programs.wrap(k, fn, donate=(4, 5), in_shardings=in_sh)
         self._compiled[k] = fn
         return fn
 
@@ -1517,7 +1547,9 @@ class ModelRunner:
                                         self.kv_sharding),
                          donate_argnums=donate)
         else:
+            in_sh = None
             fn = jax.jit(spec, donate_argnums=donate)
+        fn = self._programs.wrap(k, fn, donate=donate, in_shardings=in_sh)
         self._compiled[k] = fn
         return fn
 
@@ -1691,9 +1723,16 @@ class ModelRunner:
                     params, cfg, inv_freq, toks, lens
                 )
             )
-            self._compiled[key] = fn
+            in_sh = None
+            if self.mesh is not None:
+                r = self._replicated
+                in_sh = (self.param_shardings, r, r, r)
+            self._compiled[key] = self._programs.wrap(
+                key, fn, donate=(), in_shardings=in_sh
+            )
         out = self._compiled[key](
-            self.params, self.inv_freq, jnp.asarray(tokens), jnp.asarray(lengths)
+            self.params, self.inv_freq,
+            self.upload(tokens), self.upload(lengths),
         )
         return jax.device_get(out)[:n]  # intended blocking fetch
 
